@@ -1,0 +1,29 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capability
+surface of Apache MXNet v0.11 (reference: Guneet-Dhillon/mxnet).
+
+Idiomatic re-design, not a port (SURVEY.md §7): the reference's dependency
+engine / memory planner / CUDA kernels are replaced by XLA's async dispatch,
+buffer assignment and codegen; distribution is mesh-sharding + collectives
+instead of ps-lite; custom kernels are Pallas instead of NVRTC.
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx
+    a = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=10)
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+"""
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_devices
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
+    "num_devices", "nd", "ndarray", "random", "autograd",
+]

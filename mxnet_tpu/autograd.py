@@ -1,0 +1,209 @@
+"""Imperative autograd.
+
+Reference: ``src/ndarray/autograd.{h,cc}`` + ``python/mxnet/autograd.py``
+(SURVEY.md §2.4): MXNet records an AGNode tape during imperative execution and
+computes gradients by reconstructing an nnvm graph and running a throwaway
+GraphExecutor backward.
+
+TPU design: same tape-by-reconstruction idea, but the reconstruction target is
+a *pure JAX function* and the backward engine is ``jax.vjp``. Replaying the
+tape re-traces every recorded op with its captured attrs (including the exact
+PRNG keys, so dropout masks replay identically) and lets XLA differentiate,
+fuse and schedule the whole backward — the reference's per-op FGradient
+registrations and backward executor disappear.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording",
+    "is_training", "mark_variables", "backward", "set_recording",
+    "set_training",
+]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+        _state.marked = {}
+    return _state
+
+
+class _TapeEntry:
+    __slots__ = ("op", "attrs", "inputs", "input_consts", "outputs")
+
+    def __init__(self, op, attrs, inputs, outputs):
+        self.op = op
+        self.attrs = attrs
+        self.inputs = inputs          # list of NDArray refs
+        self.input_consts = [a.data for a in inputs]  # values at record time
+        self.outputs = outputs        # list of NDArray refs
+
+
+def _record_op(op, attrs, inputs, outputs) -> None:
+    """Called by the imperative dispatch layer for every op executed while
+    recording (reference hook: MXImperativeInvoke -> RecordImperativeFCompute,
+    src/c_api/c_api_ndarray.cc:400, src/ndarray/autograd.cc:104)."""
+    _st().tape.append(_TapeEntry(op, attrs, list(inputs), list(outputs)))
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    s = _st()
+    prev, s.recording = s.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    s = _st()
+    prev, s.training = s.training, train
+    return prev
+
+
+class _RecordingStateScope:
+    """(reference: python/mxnet/autograd.py _RecordingStateScope)."""
+
+    def __init__(self, is_record: Optional[bool], train: Optional[bool]):
+        self._rec, self._train = is_record, train
+        self._prev_rec = self._prev_train = None
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._prev_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._prev_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` (reference: python/mxnet/autograd.py:120)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """(reference: python/mxnet/autograd.py:144)."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    """(reference: python/mxnet/autograd.py train_mode)."""
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Attach gradient buffers to arrays (reference:
+    src/ndarray/autograd.cc:78-102, python surface autograd.py:195)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    s = _st()
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad
+        var._grad_req = req
+        s.marked[id(var)] = var
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True) -> None:
+    """Compute gradients of heads w.r.t. all marked variables (reference:
+    AutogradRuntime::ComputeGradient, src/ndarray/autograd.cc:229-320).
+
+    Reconstructs a pure function marked-vars -> heads by replaying the tape,
+    then runs one ``jax.vjp``. Gradients land in each variable's attached
+    grad buffer honoring its grad_req (write/add/null — reference
+    OpReqType semantics, include/mxnet/op_attr_types.h:45-58).
+    """
+    from .ndarray import NDArray  # cycle-free at call time
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    s = _st()
+    tape: List[_TapeEntry] = s.tape
+
+    # Which marked variables feed the heads? Walk tape backwards from heads.
+    needed = {id(h) for h in heads}
+    used_entries = []
+    for entry in reversed(tape):
+        if any(id(o) in needed for o in entry.outputs):
+            used_entries.append(entry)
+            needed.update(id(i) for i in entry.inputs)
+    used_entries.reverse()
+
+    variables = [v for vid, v in s.marked.items() if vid in needed]
+    if not variables:
+        raise ValueError(
+            "backward: no marked variables reach the heads — call "
+            "mark_variables/attach_grad and compute inside autograd.record()")
+
+    var_ids = [id(v) for v in variables]
+    head_ids = [id(h) for h in heads]
+
+    def replay(var_values):
+        env = dict(zip(var_ids, var_values))
+        for entry in used_entries:
+            args = [
+                env.get(id(inp), const)
+                for inp, const in zip(entry.inputs, entry.input_consts)
+            ]
+            outs = entry.op.fn(*args, **entry.attrs)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for o_nd, o_val in zip(entry.outputs, outs):
+                env[id(o_nd)] = o_val
+        return [env[h] for h in head_ids]
+
+    primals = [v.data for v in variables]
+    head_vals, vjp_fn = jax.vjp(lambda *vs: replay(list(vs)), *primals)
+    if head_grads is None:
+        cts = [jnp.ones_like(h) for h in head_vals]
+    else:
+        cts = [
+            (g.data if isinstance(g, NDArray) else jnp.asarray(g))
+            if g is not None else jnp.ones_like(h)
+            for g, h in zip(head_grads, head_vals)
+        ]
+    grads = vjp_fn(cts)
+    for var, g in zip(variables, grads):
+        req = getattr(var, "_grad_req", "write")
+        if req == "null" or var._grad is None:
+            continue
+        if req == "add":
+            var._grad._data = var._grad.data + g
+        else:
+            var._grad._data = g.astype(var._grad.dtype)
+    if not retain_graph:
+        s.tape = []
+
+
+def get_symbol(x):  # pragma: no cover - reference-API stub
+    """The reference exposes autograd.get_symbol; the TPU build's tape has no
+    nnvm symbol to return. Use Symbol tracing instead."""
+    raise NotImplementedError("use mxnet_tpu.symbol tracing instead")

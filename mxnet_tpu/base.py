@@ -1,0 +1,57 @@
+"""Base utilities for mxnet_tpu.
+
+This module plays the role of the reference's ``python/mxnet/base.py`` (handle
+types, dtype tables, error plumbing — reference: python/mxnet/base.py:1-347),
+minus the ctypes bridge: there is no C ABI between the Python frontend and the
+execution engine here — JAX/XLA *is* the native core, and the Python layer
+talks to it directly.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "_DTYPE_NP_TO_MX",
+    "_DTYPE_MX_TO_NP",
+    "mx_real_t",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: python/mxnet/base.py:66)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# dtype enum kept for serialization compatibility with the reference's NDArray
+# binary format (reference: python/mxnet/ndarray.py:54-76). Entry 7 (bfloat16)
+# is a TPU-native addition with no counterpart in the 2017 reference.
+_DTYPE_NP_TO_MX = {
+    None: -1,
+    _np.dtype(_np.float32): 0,
+    _np.dtype(_np.float64): 1,
+    _np.dtype(_np.float16): 2,
+    _np.dtype(_np.uint8): 3,
+    _np.dtype(_np.int32): 4,
+    _np.dtype(_np.int8): 5,
+    _np.dtype(_np.int64): 6,
+}
+try:  # bfloat16 is first-class on TPU
+    import ml_dtypes as _ml_dtypes
+
+    _DTYPE_NP_TO_MX[_np.dtype(_ml_dtypes.bfloat16)] = 7
+except ImportError:  # pragma: no cover
+    pass
+
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+mx_real_t = _np.float32
+
+
+def check_call(ret):  # pragma: no cover - API-compat shim
+    """No-op shim: there is no C return code to check in the TPU build."""
+    return ret

@@ -1,0 +1,54 @@
+"""``mx.nd`` — imperative namespace.
+
+Every registered op gets an auto-generated wrapper, mirroring how the
+reference builds ``mx.nd.*`` from the C op registry at import time
+(reference: python/mxnet/ndarray.py ``_init_ndarray_module``).
+"""
+from __future__ import annotations
+
+import sys as _sys
+import numpy as _np
+
+from ..ops import OP_REGISTRY, get_op
+from .ndarray import (
+    NDArray, imperative_invoke, array, empty, waitall, concatenate,
+    moveaxis, onehot_encode, save, load,
+)
+
+__all__ = [
+    "NDArray", "array", "empty", "waitall", "concatenate", "moveaxis",
+    "onehot_encode", "save", "load", "imperative_invoke",
+]
+
+
+def _make_wrapper(op):
+    def wrapper(*args, **kwargs):
+        return imperative_invoke(op, *args, **kwargs)
+    wrapper.__name__ = op.name
+    wrapper.__doc__ = op.__doc__
+    return wrapper
+
+
+_mod = _sys.modules[__name__]
+for _name, _op in list(OP_REGISTRY.items()):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_wrapper(_op))
+        __all__.append(_name)
+
+
+# `random` sub-namespace: mx.nd.random.uniform etc. (later reference versions
+# moved samplers under mx.nd.random; the 0.11 flat names also exist above)
+class _RandomNamespace:
+    uniform = staticmethod(getattr(_mod, "_random_uniform"))
+    normal = staticmethod(getattr(_mod, "_random_normal"))
+    gamma = staticmethod(getattr(_mod, "_random_gamma"))
+    exponential = staticmethod(getattr(_mod, "_random_exponential"))
+    poisson = staticmethod(getattr(_mod, "_random_poisson"))
+    negative_binomial = staticmethod(getattr(_mod, "_random_negative_binomial"))
+    generalized_negative_binomial = staticmethod(
+        getattr(_mod, "_random_generalized_negative_binomial"))
+    multinomial = staticmethod(getattr(_mod, "_sample_multinomial"))
+    shuffle = staticmethod(getattr(_mod, "shuffle"))
+
+
+random = _RandomNamespace()

@@ -1,0 +1,468 @@
+"""NDArray — the imperative array type.
+
+Reference: ``include/mxnet/ndarray.h:77-430`` + ``python/mxnet/ndarray.py``
+(SURVEY.md §2.3). The reference NDArray is a ref-counted Chunk with an engine
+dependency variable; ops are closures pushed to the threaded engine and the
+frontend only blocks on ``asnumpy()``/``wait_to_read()``.
+
+TPU design: NDArray wraps a ``jax.Array``. JAX dispatch is *already* async —
+``jax.Array`` is a future and XLA orders operations on the device stream — so
+the reference's entire dependency-engine layer (src/engine/, ~2,300 LoC)
+collapses into this wrapper (SURVEY.md §2.1 translation note):
+
+* ``wait_to_read`` ≡ ``block_until_ready``
+* engine read/write vars ≡ XLA program order (no data races by construction)
+* ``FnProperty::kCopyFromGPU`` priority lanes ≡ PJRT transfer streams
+
+Mutation model: JAX buffers are immutable, so "in-place" writes rebind the
+wrapped buffer on the *same* NDArray object. Executors and optimizers hold
+NDArray references and read ``.data`` at call time, which preserves the
+reference's shared-buffer semantics at the object level. (Divergence: a
+sliced view does not alias its parent's storage.)
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import autograd as _autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ops import OP_REGISTRY, OpDef, get_op
+
+__all__ = ["NDArray", "imperative_invoke", "array", "empty", "waitall",
+           "concatenate", "moveaxis", "onehot_encode", "save", "load"]
+
+
+def _ctx_of(data: jax.Array) -> Context:
+    try:
+        dev = data.device
+    except Exception:
+        dev = list(data.devices())[0]
+    kind = "cpu" if dev.platform == "cpu" else "tpu"
+    return Context(kind, dev.id)
+
+
+class NDArray:
+    """Multi-device, async n-dimensional array (reference:
+    python/mxnet/ndarray.py:138)."""
+
+    __slots__ = ("_data", "_grad", "_grad_req")
+    # numpy should defer to our reflected dunders
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = np.asarray(data, dtype=dtype)
+            dev = (ctx or current_context()).jax_device
+            data = jax.device_put(jnp.asarray(data), dev)
+        elif dtype is not None and jnp.dtype(dtype) != data.dtype:
+            data = data.astype(jnp.dtype(dtype))
+        if ctx is not None and isinstance(data, jax.Array):
+            dev = ctx.jax_device
+            try:
+                cur = data.device
+            except Exception:
+                cur = None
+            if cur is not None and cur != dev:
+                data = jax.device_put(data, dev)
+        self._data = data
+        self._grad: Optional["NDArray"] = None
+        self._grad_req: str = "write"
+
+    # ------------------------------------------------------------ basics
+    @property
+    def data(self) -> jax.Array:
+        return self._data
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return _ctx_of(self._data)
+
+    ctx = context
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def T(self) -> "NDArray":
+        return imperative_invoke(get_op("transpose"), self)
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            np.asarray(self._data), "x".join(map(str, self.shape)), self.context)
+
+    # ------------------------------------------------------- sync points
+    def asnumpy(self) -> np.ndarray:
+        """Blocking device->host copy (reference: ndarray.py asnumpy /
+        SyncCopyToCPU src/ndarray/ndarray.cc:779)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self) -> None:
+        """Block until the async computation producing this array finishes
+        (reference: ndarray.h:156 WaitToRead via Engine::WaitForVar)."""
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    # ------------------------------------------------------- conversions
+    def astype(self, dtype) -> "NDArray":
+        return imperative_invoke(get_op("Cast"), self, dtype=np.dtype(dtype).name)
+
+    def copy(self) -> "NDArray":
+        return NDArray(jnp.asarray(self._data))
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        """(reference: CopyFromTo src/ndarray/ndarray.cc:343-405 — the
+        cross-device copy primitive; here one jax.device_put)."""
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device))
+        other._data = jax.device_put(
+            self._data.astype(other.dtype), other.context.jax_device)
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device))
+
+    def detach(self) -> "NDArray":
+        return NDArray(jax.lax.stop_gradient(self._data))
+
+    # ------------------------------------------------------- autograd
+    def attach_grad(self, grad_req: str = "write") -> None:
+        """(reference: gluon Parameter/autograd; MarkVariables
+        src/ndarray/autograd.cc:78)."""
+        grad = NDArray(jnp.zeros_like(self._data))
+        _autograd.mark_variables([self], [grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _autograd.backward([self], [out_grad] if out_grad is not None else None,
+                           retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------- indexing
+    def __getitem__(self, key) -> "NDArray":
+        return NDArray(self._data[key])
+
+    def __setitem__(self, key, value):
+        val = value._data if isinstance(value, NDArray) else value
+        if isinstance(key, slice) and key == slice(None):
+            if np.isscalar(val):
+                self._data = jnp.full_like(self._data, val)
+            else:
+                self._data = jnp.broadcast_to(
+                    jnp.asarray(val, dtype=self._data.dtype), self.shape
+                ).astype(self._data.dtype)
+            return
+        self._data = self._data.at[key].set(val)
+
+    # ------------------------------------------------------- arithmetic
+    def _binop(self, other, opname, scalar_opname, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return imperative_invoke(get_op(opname), a, b)
+        return imperative_invoke(get_op(scalar_opname), self, scalar=float(other))
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binop(o, "elemwise_sub", "_rminus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "elemwise_div", "_rdiv_scalar", reverse=True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar")
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binop(o, "broadcast_power", "_rpower_scalar", reverse=True)
+
+    def __neg__(self):
+        return imperative_invoke(get_op("negative"), self)
+
+    def __abs__(self):
+        return imperative_invoke(get_op("abs"), self)
+
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._data = out._data
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._data = out._data
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._data = out._data
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._data = out._data
+        return self
+
+    def __eq__(self, o):
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    # ------------------------------------------------------- op methods
+    def reshape(self, shape=None, **kwargs) -> "NDArray":
+        if shape is None:
+            shape = kwargs.get("shape")
+        if isinstance(shape, int):
+            shape = (shape,)
+        return imperative_invoke(get_op("Reshape"), self, shape=tuple(shape))
+
+
+def _attach_op_methods():
+    """Expose common ops as NDArray methods, like the reference's generated
+    methods on NDArray (python/mxnet/ndarray.py autogen tail)."""
+    names = [
+        "sum", "mean", "max", "min", "prod", "argmax", "argmin", "clip",
+        "abs", "sign", "round", "floor", "ceil", "sqrt", "square", "exp",
+        "log", "sigmoid", "tanh", "relu", "softmax", "log_softmax",
+        "transpose", "swapaxes", "flatten", "expand_dims", "repeat", "tile",
+        "flip", "sort", "argsort", "topk", "pick", "take", "one_hot",
+        "broadcast_to", "slice_axis", "squeeze", "astype_", "norm",
+        "split", "slice",
+    ]
+    for nm in names:
+        if nm.endswith("_") or nm not in OP_REGISTRY:
+            continue
+        if hasattr(NDArray, nm):
+            continue
+
+        def make(nm):
+            def method(self, *args, **kwargs):
+                return imperative_invoke(get_op(nm), self, *args, **kwargs)
+            method.__name__ = nm
+            method.__doc__ = OP_REGISTRY[nm].__doc__
+            return method
+
+        setattr(NDArray, nm, make(nm))
+
+
+# --------------------------------------------------------------- dispatch
+
+def _accepts_is_train(op: OpDef) -> bool:
+    cached = getattr(op, "_accepts_is_train", None)
+    if cached is None:
+        try:
+            params = inspect.signature(op.fn).parameters
+            cached = "_is_train" in params
+        except (TypeError, ValueError):
+            cached = False
+        op._accepts_is_train = cached
+    return cached
+
+
+def imperative_invoke(op: OpDef, *args, out=None, ctx=None, **attrs):
+    """Execute a registered op eagerly (reference: MXImperativeInvoke →
+    ImperativeInvokeImpl → PushFCompute, src/c_api/c_api_ndarray.cc:262-423).
+
+    The reference computes engine read/write vars and pushes an async closure;
+    here JAX's async dispatch provides the same non-blocking behavior. The
+    autograd hook mirrors c_api_ndarray.cc:400-417.
+    """
+    nd_args = [a for a in args if isinstance(a, NDArray)]
+    jax_args = [a._data if isinstance(a, NDArray) else a for a in args]
+    attrs = dict(attrs)
+    attrs.pop("name", None)  # symbol-layer attr, meaningless imperatively
+    if op.needs_rng and attrs.get("_rng") is None:
+        attrs["_rng"] = _random.next_key()
+    if _accepts_is_train(op):
+        attrs.setdefault("_is_train", _autograd.is_training())
+    if op.num_inputs == 0 and not nd_args:
+        dev = (ctx or current_context()).jax_device
+        with jax.default_device(dev):
+            outputs = op.fn(*jax_args, **attrs)
+    else:
+        outputs = op.fn(*jax_args, **attrs)
+    single = not isinstance(outputs, tuple)
+    if single:
+        outputs = (outputs,)
+    out_nds = [NDArray(o) for o in outputs]
+
+    # aux-state commit (BatchNorm moving stats): trailing num_aux outputs are
+    # written back into the trailing num_aux NDArray inputs.
+    if op.num_aux:
+        aux_inputs = nd_args[-op.num_aux:]
+        for aux_nd, new_val in zip(aux_inputs, out_nds[-op.num_aux:]):
+            aux_nd._data = new_val._data
+        out_nds = out_nds[: len(out_nds) - op.num_aux]
+
+    if _autograd.is_recording() and not op.is_random:
+        _autograd._record_op(op, attrs, nd_args, out_nds)
+
+    # hide extra outputs (e.g. BatchNorm mean/var) unless requested
+    visible = out_nds
+    if op.num_hidden_outputs and not attrs.get("output_mean_var"):
+        visible = out_nds[: len(out_nds) - op.num_hidden_outputs]
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o, v in zip(outs, visible):
+            o._data = v._data
+        return out
+    if len(visible) == 1:
+        return visible[0]
+    return visible
+
+
+# --------------------------------------------------------------- helpers
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (reference: ndarray.py array)."""
+    if isinstance(source_array, NDArray):
+        return NDArray(source_array._data, ctx=ctx, dtype=dtype)
+    # reference semantics: default dtype is mx_real_t (float32) regardless of
+    # the source's dtype (python/mxnet/ndarray.py array)
+    arr = np.asarray(source_array, dtype=dtype if dtype is not None else np.float32)
+    return NDArray(arr, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return imperative_invoke(get_op("_zeros"), shape=tuple(np.atleast_1d(shape)),
+                             dtype=np.dtype(dtype).name, ctx=ctx)
+
+
+def waitall() -> None:
+    """Block until all async computation completes (reference:
+    Engine::WaitForAll via MXNDArrayWaitAll; python/mxnet/ndarray.py:131)."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def moveaxis(tensor: NDArray, source: int, destination: int) -> NDArray:
+    return NDArray(jnp.moveaxis(tensor._data, source, destination))
+
+
+def concatenate(arrays: Sequence[NDArray], axis: int = 0, always_copy: bool = True) -> NDArray:
+    return imperative_invoke(get_op("Concat"), *arrays, dim=axis)
+
+
+def onehot_encode(indices: NDArray, out: NDArray) -> NDArray:
+    """(reference: legacy ndarray.py onehot_encode)."""
+    depth = out.shape[1]
+    res = imperative_invoke(get_op("one_hot"), indices, depth=depth)
+    out._data = res._data
+    return out
+
+
+# --------------------------------------------------------------- save/load
+
+def save(fname: str, data) -> None:
+    """Save list/dict of NDArrays (reference: src/ndarray/ndarray.cc:668-777
+    Save/Load + MXNDArraySave). Container format: npz archive holding each
+    tensor plus an ordering manifest — same capability (named/ordered tensor
+    checkpoint), TPU-era container."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+        keys = names
+    else:
+        keys = None
+        arrays = list(data)
+    payload = {}
+    for i, arr in enumerate(arrays):
+        key = keys[i] if keys is not None else "__arr_%d__" % i
+        payload[key] = np.asarray(arr.asnumpy())
+    manifest = np.array(
+        ["dict" if keys is not None else "list"] + [k for k in payload.keys()],
+        dtype=object)
+    with open(fname, "wb") as f:
+        np.savez(f, __manifest__=manifest, **payload)
+
+
+def load(fname: str):
+    """(reference: mx.nd.load)."""
+    with np.load(fname, allow_pickle=True) as zf:
+        manifest = list(zf["__manifest__"])
+        kind, keys = manifest[0], manifest[1:]
+        out = {k: array(zf[k]) for k in keys}
+    if kind == "list":
+        return [out[k] for k in keys]
+    return out
+
+
+_attach_op_methods()

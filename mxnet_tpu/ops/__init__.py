@@ -1,0 +1,18 @@
+"""Operator catalog: importing this package populates the registry.
+
+The registry is the single source of truth for both ``mx.nd.*`` and
+``mx.sym.*`` auto-generated wrappers (SURVEY.md §7 step 2).
+"""
+from .registry import OpDef, OP_REGISTRY, register, alias, get_op, list_ops
+
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import indexing  # noqa: F401
+from . import init_op  # noqa: F401
+from . import random_op  # noqa: F401
+from . import nn  # noqa: F401
+from . import sequence  # noqa: F401
+from . import contrib  # noqa: F401
+
+__all__ = ["OpDef", "OP_REGISTRY", "register", "alias", "get_op", "list_ops"]

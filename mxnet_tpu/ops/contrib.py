@@ -1,0 +1,102 @@
+"""Contrib ops + the fork's Stochastic Activation Pruning operator.
+
+Reference: ``src/operator/contrib/`` (SURVEY.md §2.5 contrib/) and the fork
+delta ``src/operator/stochastic_activation_pruning-inl.h:1-277`` (the repo's
+one divergence from upstream Apache MXNet 0.11 — the ICLR'18 SAP
+adversarial-defense op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("stochastic_activation_pruning", num_inputs=2, needs_rng=True,
+          aliases=("StochasticActivationPruning", "sap"))
+def stochastic_activation_pruning(act, prob, frac=1.0, _rng=None):
+    """Stochastic Activation Pruning (reference:
+    src/operator/stochastic_activation_pruning-inl.h:66-137).
+
+    Inputs flatten to 2-D (rows, cols). Per row, draw ``k = frac * cols``
+    categorical samples from ``prob``; kept activations are rescaled by the
+    inverse retention propensity ``1 / (1 - (1-p)^k)``; the rest are zeroed.
+    Backward flows ``grad * mask`` into ``act`` and zero into ``prob``
+    (reference lines 139-178) — here that falls out of vjp because ``mask``
+    is built from ``stop_gradient`` samples.
+
+    TPU lowering: one ``jax.random.categorical`` batch draw + a scatter; the
+    reference's nested OpenMP/CUDA sampling loop becomes two fused HLOs.
+    """
+    shape = act.shape
+    rows = shape[0] if act.ndim > 1 else 1
+    a2 = act.reshape(rows, -1)
+    p2 = prob.reshape(rows, -1)
+    cols = a2.shape[1]
+    k = max(int(frac * cols), 1)
+    logits = jnp.log(jnp.maximum(jax.lax.stop_gradient(p2), 1e-37))
+    idx = jax.random.categorical(_rng, logits[:, None, :], axis=-1,
+                                 shape=(rows, k))
+    weights = 1.0 / (1.0 - jnp.power(1.0 - jax.lax.stop_gradient(p2), k))
+    mask = jnp.zeros_like(a2)
+    rowix = jnp.arange(rows)[:, None]
+    mask = mask.at[rowix, idx].set(jnp.take_along_axis(weights, idx, axis=1))
+    return (a2 * mask).reshape(shape)
+
+
+@register("quantize", num_inputs=3, aliases=("_contrib_quantize",))
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine quantization (reference: src/operator/contrib/quantize.cc)."""
+    if out_type == "uint8":
+        qmin, qmax = 0.0, 255.0
+        dt = jnp.uint8
+    else:
+        qmin, qmax = -127.0, 127.0
+        dt = jnp.int8
+    scale = (qmax - qmin) / (max_range - min_range)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+@register("dequantize", num_inputs=3, aliases=("_contrib_dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """(reference: src/operator/contrib/dequantize.cc)."""
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return ((data.astype(jnp.float32) - qmin) * scale + min_range).astype(
+        jnp.dtype(out_type))
+
+
+@register("count_sketch", num_inputs=3, aliases=("_contrib_count_sketch",))
+def count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
+    """Count-sketch projection (reference: src/operator/contrib/count_sketch.cc).
+    out[n, h[i]] += s[i] * data[n, i] — a scatter-add on TPU."""
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    n = data.shape[0]
+    out = jnp.zeros((n, int(out_dim)), dtype=data.dtype)
+    return out.at[:, idx].add(data * sign)
+
+
+@register("fft", aliases=("_contrib_fft",))
+def fft(data, compute_size=128):
+    """FFT along last axis, complex packed as interleaved re/im like the
+    reference cuFFT op (reference: src/operator/contrib/fft-inl.h)."""
+    out = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    packed = jnp.stack([out.real, out.imag], axis=-1)
+    return packed.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register("ifft", aliases=("_contrib_ifft",))
+def ifft(data, compute_size=128):
+    """(reference: src/operator/contrib/ifft-inl.h). Input packs re/im
+    interleaved; output is the real part scaled like cuFFT (unnormalized)."""
+    n = data.shape[-1] // 2
+    x = data.reshape(data.shape[:-1] + (n, 2)).astype(jnp.float32)
+    c = x[..., 0] + 1j * x[..., 1]
+    out = jnp.fft.ifft(c, axis=-1) * n
+    return out.real.astype(data.dtype)

@@ -1,0 +1,274 @@
+"""Elementwise unary/binary/scalar/logic ops.
+
+Reference: ``src/operator/tensor/elemwise_unary_op.cc``,
+``elemwise_binary_op_basic.cc``, ``elemwise_binary_broadcast_op_*.cc``,
+``elemwise_binary_scalar_op_*.cc`` and the ``mshadow_op.h`` functor zoo
+(SURVEY.md §2.5 tensor/ family). Each reference op is a hand-written cpu/gpu
+kernel pair; here each is one jnp expression — XLA fuses chains of these into
+single HBM-bandwidth-bound kernels, which is precisely the TPU-idiomatic
+replacement for mshadow expression templates.
+
+Note on broadcast_* vs elemwise_*: the reference distinguishes same-shape
+``elemwise_add`` from numpy-broadcasting ``broadcast_add``. XLA handles both
+with one HLO, so they alias to the same lowering here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------- binary
+
+
+@register("elemwise_add", num_inputs=2, aliases=("_plus", "_Plus", "broadcast_add", "broadcast_plus"))
+def elemwise_add(lhs, rhs):
+    """lhs + rhs (reference: src/operator/tensor/elemwise_binary_op_basic.cc:40)."""
+    return jnp.add(lhs, rhs)
+
+
+@register("elemwise_sub", num_inputs=2, aliases=("_minus", "_Minus", "broadcast_sub", "broadcast_minus"))
+def elemwise_sub(lhs, rhs):
+    return jnp.subtract(lhs, rhs)
+
+
+@register("elemwise_mul", num_inputs=2, aliases=("_mul", "_Mul", "broadcast_mul"))
+def elemwise_mul(lhs, rhs):
+    return jnp.multiply(lhs, rhs)
+
+
+@register("elemwise_div", num_inputs=2, aliases=("_div", "_Div", "broadcast_div"))
+def elemwise_div(lhs, rhs):
+    return jnp.divide(lhs, rhs)
+
+
+@register("broadcast_power", num_inputs=2, aliases=("_power", "_Power", "pow"))
+def broadcast_power(lhs, rhs):
+    return jnp.power(lhs, rhs)
+
+
+@register("broadcast_maximum", num_inputs=2, aliases=("_maximum", "maximum"))
+def broadcast_maximum(lhs, rhs):
+    return jnp.maximum(lhs, rhs)
+
+
+@register("broadcast_minimum", num_inputs=2, aliases=("_minimum", "minimum"))
+def broadcast_minimum(lhs, rhs):
+    return jnp.minimum(lhs, rhs)
+
+
+@register("broadcast_hypot", num_inputs=2, aliases=("_hypot",))
+def broadcast_hypot(lhs, rhs):
+    return jnp.hypot(lhs, rhs)
+
+
+@register("broadcast_mod", num_inputs=2, aliases=("_mod",))
+def broadcast_mod(lhs, rhs):
+    return jnp.mod(lhs, rhs)
+
+
+# ---------------------------------------------------------------- logic
+
+def _logic(fn):
+    def wrapped(lhs, rhs):
+        return fn(lhs, rhs).astype(jnp.result_type(lhs))
+    return wrapped
+
+
+register("broadcast_equal", num_inputs=2, aliases=("_equal",))(_logic(jnp.equal))
+register("broadcast_not_equal", num_inputs=2, aliases=("_not_equal",))(_logic(jnp.not_equal))
+register("broadcast_greater", num_inputs=2, aliases=("_greater",))(_logic(jnp.greater))
+register("broadcast_greater_equal", num_inputs=2, aliases=("_greater_equal",))(_logic(jnp.greater_equal))
+register("broadcast_lesser", num_inputs=2, aliases=("_lesser",))(_logic(jnp.less))
+register("broadcast_lesser_equal", num_inputs=2, aliases=("_lesser_equal",))(_logic(jnp.less_equal))
+
+
+# ---------------------------------------------------------------- scalar
+
+@register("_plus_scalar", aliases=("_PlusScalar",))
+def _plus_scalar(data, scalar=0.0):
+    return data + scalar
+
+
+@register("_minus_scalar", aliases=("_MinusScalar",))
+def _minus_scalar(data, scalar=0.0):
+    return data - scalar
+
+
+@register("_rminus_scalar", aliases=("_RMinusScalar",))
+def _rminus_scalar(data, scalar=0.0):
+    return scalar - data
+
+
+@register("_mul_scalar", aliases=("_MulScalar",))
+def _mul_scalar(data, scalar=1.0):
+    return data * scalar
+
+
+@register("_div_scalar", aliases=("_DivScalar",))
+def _div_scalar(data, scalar=1.0):
+    return data / scalar
+
+
+@register("_rdiv_scalar", aliases=("_RDivScalar",))
+def _rdiv_scalar(data, scalar=1.0):
+    return scalar / data
+
+
+@register("_power_scalar", aliases=("_PowerScalar",))
+def _power_scalar(data, scalar=1.0):
+    return jnp.power(data, scalar)
+
+
+@register("_rpower_scalar", aliases=("_RPowerScalar",))
+def _rpower_scalar(data, scalar=1.0):
+    return jnp.power(scalar, data)
+
+
+@register("_maximum_scalar", aliases=("_MaximumScalar",))
+def _maximum_scalar(data, scalar=0.0):
+    return jnp.maximum(data, scalar)
+
+
+@register("_minimum_scalar", aliases=("_MinimumScalar",))
+def _minimum_scalar(data, scalar=0.0):
+    return jnp.minimum(data, scalar)
+
+
+@register("_mod_scalar")
+def _mod_scalar(data, scalar=1.0):
+    return jnp.mod(data, scalar)
+
+
+@register("_equal_scalar")
+def _equal_scalar(data, scalar=0.0):
+    return (data == scalar).astype(jnp.result_type(data))
+
+
+@register("_not_equal_scalar")
+def _not_equal_scalar(data, scalar=0.0):
+    return (data != scalar).astype(jnp.result_type(data))
+
+
+@register("_greater_scalar")
+def _greater_scalar(data, scalar=0.0):
+    return (data > scalar).astype(jnp.result_type(data))
+
+
+@register("_greater_equal_scalar")
+def _greater_equal_scalar(data, scalar=0.0):
+    return (data >= scalar).astype(jnp.result_type(data))
+
+
+@register("_lesser_scalar")
+def _lesser_scalar(data, scalar=0.0):
+    return (data < scalar).astype(jnp.result_type(data))
+
+
+@register("_lesser_equal_scalar")
+def _lesser_equal_scalar(data, scalar=0.0):
+    return (data <= scalar).astype(jnp.result_type(data))
+
+
+# ---------------------------------------------------------------- unary
+# reference: src/operator/tensor/elemwise_unary_op.cc + mshadow_op.h functors
+
+_UNARY = {
+    "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,  # fix == trunc (round toward zero)
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt,
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "gamma": lambda x: jnp.exp(lax.lgamma(x)),
+    "gammaln": lambda x: lax.lgamma(x),
+    "erf": lax.erf,
+    "erfinv": lax.erf_inv,
+    "sigmoid": lambda x: jax_nn_sigmoid(x),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+}
+
+
+def jax_nn_sigmoid(x):
+    return lax.logistic(x)
+
+
+def _make_unary(name, fn):
+    @register(name)
+    def _op(data, _fn=fn):
+        return _fn(data)
+    _op.__doc__ = "Elementwise %s (reference: src/operator/tensor/elemwise_unary_op.cc)." % name
+    return _op
+
+
+for _name, _fn in _UNARY.items():
+    _make_unary(_name, _fn)
+
+alias("gamma", "tgamma")
+
+
+@register("BlockGrad", aliases=("stop_gradient", "block_grad"))
+def block_grad(data):
+    """Identity forward, zero gradient (reference:
+    src/operator/tensor/elemwise_unary_op.cc BlockGrad). TPU lowering:
+    lax.stop_gradient."""
+    return lax.stop_gradient(data)
+
+
+@register("identity", aliases=("_copy",))
+def identity(data):
+    return jnp.asarray(data)
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, dtype="float32"):
+    """Cast to dtype (reference: elemwise_unary_op.cc Cast)."""
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("clip")
+def clip(data, a_min=0.0, a_max=1.0):
+    """Clip values to [a_min, a_max] (reference: src/operator/tensor/matrix_op.cc clip)."""
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """Smooth L1 (reference: mshadow_op.h smooth_l1_loss; used by RCNN)."""
+    s2 = scalar * scalar
+    return jnp.where(
+        jnp.abs(data) < 1.0 / s2,
+        0.5 * s2 * jnp.square(data),
+        jnp.abs(data) - 0.5 / s2,
+    )

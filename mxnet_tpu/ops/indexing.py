@@ -1,0 +1,108 @@
+"""Indexing / embedding / ordering ops.
+
+Reference: ``src/operator/tensor/indexing_op.cc`` (Embedding, take, one_hot,
+pick, batch_take) and ``ordering_op.cc`` (sort, argsort, topk). On TPU, gather
+is the lowering for all of take/Embedding/pick; sort/topk map to XLA's
+variadic sort — static output shapes keep everything jit-compatible.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("Embedding", num_inputs=2, aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32"):
+    """Lookup rows of ``weight`` by integer ids (reference: indexing_op.cc
+    Embedding). One XLA gather; gradient is a scatter-add, which is exactly
+    kAddTo semantics from the reference (op_attr_types.h:45-58) for free."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("take", num_inputs=2)
+def take(a, indices, axis=0, mode="clip"):
+    """Take elements along axis (reference: indexing_op.cc take)."""
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis, mode=mode if mode != "raise" else "clip")
+
+
+@register("batch_take", num_inputs=2)
+def batch_take(a, indices):
+    """a[i, indices[i]] (reference: indexing_op.cc batch_take)."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1
+    ).squeeze(1)
+
+
+@register("one_hot")
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    """(reference: indexing_op.cc one_hot)."""
+    idx = indices.astype(jnp.int32)
+    oh = jnp.equal(idx[..., None], jnp.arange(depth, dtype=jnp.int32))
+    return jnp.where(oh, on_value, off_value).astype(jnp.dtype(dtype))
+
+
+@register("pick", num_inputs=2)
+def pick(data, index, axis=-1, keepdims=False):
+    """Pick one element per row along axis by index (reference:
+    broadcast_reduce_op_index.cc pick; the backbone of cross-entropy)."""
+    idx = index.astype(jnp.int32)
+    axis = axis % data.ndim
+    idx_exp = jnp.expand_dims(idx, axis) if idx.ndim < data.ndim else idx
+    out = jnp.take_along_axis(data, idx_exp, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd", num_inputs=2)
+def gather_nd(data, indices):
+    """N-d gather (TPU-build extension; appears in later reference versions)."""
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+# ------------------------------------------------------------- ordering
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    """(reference: ordering_op.cc sort)."""
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.float32)
+
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    """Top-k along axis (reference: ordering_op.cc topk). Static k keeps the
+    output shape jit-compatible. ret_typ: value|indices|mask|both."""
+    axis = axis % data.ndim
+    neg = data if not is_ascend else -data
+    moved = jnp.moveaxis(neg, axis, -1)
+    vals, idxs = lax.top_k(moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(jnp.float32)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    if ret_typ == "mask":
+        moved_idx = jnp.moveaxis(idxs.astype(jnp.int32), axis, -1)
+        mask = jnp.zeros(jnp.moveaxis(data, axis, -1).shape, dtype=data.dtype)
+        mask = jnp.put_along_axis(mask, moved_idx, jnp.ones((), data.dtype),
+                                  axis=-1, inplace=False)
+        return jnp.moveaxis(mask, -1, axis)
+    return idxs

@@ -1,0 +1,43 @@
+"""Creation ops (no array inputs).
+
+Reference: ``src/operator/tensor/init_op.cc`` (zeros/ones/arange/full).
+These ops have ``num_inputs=0``; the dispatch layer places results on the
+requested context's device.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_zeros", num_inputs=0, aliases=("zeros",))
+def zeros(shape=(), dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+@register("_ones", num_inputs=0, aliases=("ones",))
+def ones(shape=(), dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+@register("_full", num_inputs=0, aliases=("full",))
+def full(shape=(), value=0.0, dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jnp.full(shape, value, dtype=jnp.dtype(dtype))
+
+
+@register("_arange", num_inputs=0, aliases=("arange",))
+def arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    """(reference: init_op.cc _arange, incl. the odd `repeat` attr)."""
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat and repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", num_inputs=0, aliases=("eye",))
+def eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=jnp.dtype(dtype))

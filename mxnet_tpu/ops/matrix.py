@@ -1,0 +1,298 @@
+"""Matrix / shape-manipulation ops.
+
+Reference: ``src/operator/tensor/matrix_op.cc`` (dot, batch_dot, transpose,
+reshape with special codes, slice, expand_dims, repeat, tile, flip, ...) and
+``src/operator/tensor/la_op.cc`` (linalg family). ``dot`` is the MXU workhorse:
+we lower through ``lax.dot_general`` with a bfloat16-friendly
+``preferred_element_type`` so XLA tiles it onto the systolic array
+(SURVEY.md §6 / pallas_guide: keep matmuls large + batched).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ------------------------------------------------------------------ dot
+
+
+@register("dot", num_inputs=2)
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Matrix product (reference: src/operator/tensor/matrix_op.cc dot).
+
+    2-D x 2-D -> matmul on the MXU. Higher-rank behavior follows the
+    reference: contract last axis of lhs with first axis of rhs.
+    Accumulation in float32 regardless of input dtype (TPU best practice).
+    """
+    a = lhs.T if transpose_a and lhs.ndim == 2 else (jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs)
+    b = rhs.T if transpose_b and rhs.ndim == 2 else (jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(jnp.result_type(lhs, rhs))
+    out = jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+    return out
+
+
+@register("batch_dot", num_inputs=2)
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matmul over leading axis (reference: matrix_op.cc batch_dot;
+    used heavily by attention-style models). Maps to one XLA BatchDot."""
+    dn = (((1,) if transpose_a else (2,), (2,) if transpose_b else (1,)),
+          ((0,), (0,)))
+    return lax.dot_general(lhs, rhs, dimension_numbers=dn)
+
+
+# ------------------------------------------------------------------ shape
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    """Permute axes (reference: matrix_op.cc transpose)."""
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, shape=None, reverse=False, target_shape=None, keep_highest=False):
+    """Reshape with MXNet's special codes (reference: matrix_op.cc Reshape,
+    doc in matrix_op-inl.h):
+
+      0  -> copy this dim from input
+      -1 -> infer from remaining elements
+      -2 -> copy all remaining input dims
+      -3 -> merge two consecutive input dims
+      -4 -> split one input dim into the next two listed dims (may contain -1)
+    """
+    if shape is None or len(tuple(shape)) == 0:
+        # legacy target_shape attr (reference keeps it for back-compat)
+        return jnp.reshape(data, tuple(target_shape))
+    in_shape = list(data.shape)
+    if reverse:
+        in_shape = in_shape[::-1]
+        shape = tuple(shape)[::-1]
+    out = []
+    src = 0
+    spec = list(shape)
+    i = 0
+    while i < len(spec):
+        s = spec[i]
+        if s == 0:
+            out.append(in_shape[src]); src += 1
+        elif s == -1:
+            out.append(-1); src += 1
+        elif s == -2:
+            out.extend(in_shape[src:]); src = len(in_shape)
+        elif s == -3:
+            out.append(in_shape[src] * in_shape[src + 1]); src += 2
+        elif s == -4:
+            d1, d2 = spec[i + 1], spec[i + 2]
+            whole = in_shape[src]; src += 1
+            if d1 == -1:
+                d1 = whole // d2
+            if d2 == -1:
+                d2 = whole // d1
+            out.extend([d1, d2]); i += 2
+        else:
+            out.append(int(s))
+            if src < len(in_shape):
+                src += 1
+        i += 1
+    if reverse:
+        out = out[::-1]
+    total = int(np.prod(data.shape)) if data.ndim else 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return jnp.reshape(data, tuple(out))
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    """Collapse all but the first axis (reference: matrix_op.cc Flatten)."""
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=None, end=None, step=None):
+    """Slice along each axis with None-aware begin/end (reference:
+    matrix_op.cc slice; `crop` is its 0.11 alias)."""
+    begin = tuple(begin) if begin is not None else (None,) * data.ndim
+    end = tuple(end) if end is not None else (None,) * data.ndim
+    step = tuple(step) if step else (None,) * len(begin)
+    ix = tuple(
+        np.s_[b:e:s] for b, e, s in
+        zip(begin, end, step + (None,) * (len(begin) - len(step)))
+    )
+    return data[ix]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    """Slice one axis (reference: matrix_op.cc slice_axis)."""
+    axis = axis % data.ndim
+    ix = [np.s_[:]] * data.ndim
+    ix[axis] = np.s_[begin:end]
+    return data[tuple(ix)]
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, axis=0):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axes)
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def swapaxes(data, dim1=0, dim2=0):
+    """Swap two axes (reference: src/operator/swapaxis.cc)."""
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("Concat", num_inputs=None, aliases=("concat",))
+def concat(*data, dim=1, num_args=None):
+    """Concatenate along dim (reference: src/operator/concat.cc)."""
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack", num_inputs=None)
+def stack(*data, axis=0, num_args=None):
+    return jnp.stack(data, axis=axis)
+
+
+@register("SliceChannel", num_inputs=1, aliases=("split",))
+def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """Split into equal chunks along axis; multi-output op (reference:
+    src/operator/slice_channel.cc)."""
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("where", num_inputs=3)
+def where(condition, x, y):
+    """Elementwise select (reference: src/operator/tensor/control_flow_op.cc).
+    Data-dependent select without host control flow — jit-safe."""
+    if condition.ndim == 1 and x.ndim > 1:
+        condition = condition.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(condition != 0, x, y)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """Pad (reference: src/operator/pad.cc). pad_width is the flat 2*ndim
+    tuple exactly as the reference expects."""
+    pw = tuple(pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    if mode == "constant":
+        return jnp.pad(data, pairs, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pairs, mode="reflect")
+    raise ValueError("unknown pad mode %s" % mode)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("add_n", num_inputs=None, aliases=("ElementWiseSum", "element_wise_sum"))
+def add_n(*args, num_args=None):
+    """Sum of N arrays — the gradient-aggregation primitive (reference:
+    src/operator/tensor/elemwise_sum.cc; engine-level ElementwiseSum at
+    src/ndarray/ndarray.cc:407)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ------------------------------------------------------------------ linalg
+# reference: src/operator/tensor/la_op.cc (gemm, potrf, trsm, trmm, potri,
+# sumlogdiag) — cuBLAS/LAPACK there, one XLA op each here.
+
+
+@register("linalg_gemm", num_inputs=3)
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (a @ b) + beta * C
+
+
+@register("linalg_gemm2", num_inputs=2)
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (a @ b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(A):
+    L = jnp.linalg.cholesky(A)
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    return jnp.swapaxes(Linv, -1, -2) @ Linv
+
+
+@register("linalg_trsm", num_inputs=2)
+def linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    lower = not transpose
+    out = lax.linalg.triangular_solve(a, alpha * B, left_side=not rightside, lower=lower)
+    return out
+
+
+@register("linalg_trmm", num_inputs=2)
+def linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (B @ a if rightside else a @ B)
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("khatri_rao", num_inputs=None)
+def khatri_rao(*args, num_args=None):
+    """Column-wise Khatri-Rao product (reference: src/operator/contrib/krprod.h)."""
+    out = args[0]
+    for b in args[1:]:
+        out = jnp.einsum("ir,jr->ijr", out, b).reshape(-1, out.shape[1])
+    return out

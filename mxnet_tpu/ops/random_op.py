@@ -1,0 +1,103 @@
+"""Random sampling ops.
+
+Reference: ``src/operator/random/sample_op.cc`` — samplers backed by the
+per-device PRNG ``Resource`` (SURVEY.md §2.5 random/). The TPU design replaces
+the stateful resource with explicit ``jax.random`` keys: every sampler op
+declares ``needs_rng=True`` and receives a fresh key as ``_rng`` from the
+dispatch layer (imperative path: split off the global seed state in
+``mxnet_tpu.random``; symbolic path: the Executor threads a key per forward).
+Counter-based threefry keys make runs reproducible across meshes — something
+the reference's per-GPU mtrand streams never guaranteed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_random_uniform", num_inputs=0, needs_rng=True, is_random=True,
+          aliases=("uniform", "random_uniform", "_sample_uniform"))
+def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", _rng=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jax.random.uniform(_rng, shape, jnp.dtype(dtype), low, high)
+
+
+@register("_random_normal", num_inputs=0, needs_rng=True, is_random=True,
+          aliases=("normal", "random_normal", "_sample_normal"))
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", _rng=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return loc + scale * jax.random.normal(_rng, shape, jnp.dtype(dtype))
+
+
+@register("_random_gamma", num_inputs=0, needs_rng=True, is_random=True,
+          aliases=("random_gamma", "_sample_gamma"))
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", _rng=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return beta * jax.random.gamma(_rng, alpha, shape, jnp.dtype(dtype))
+
+
+@register("_random_exponential", num_inputs=0, needs_rng=True, is_random=True,
+          aliases=("random_exponential", "_sample_exponential"))
+def random_exponential(lam=1.0, shape=(), dtype="float32", _rng=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jax.random.exponential(_rng, shape, jnp.dtype(dtype)) / lam
+
+
+@register("_random_poisson", num_inputs=0, needs_rng=True, is_random=True,
+          aliases=("random_poisson", "_sample_poisson"))
+def random_poisson(lam=1.0, shape=(), dtype="float32", _rng=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jax.random.poisson(_rng, lam, shape).astype(jnp.dtype(dtype))
+
+
+@register("_random_negative_binomial", num_inputs=0, needs_rng=True, is_random=True,
+          aliases=("random_negative_binomial", "_sample_negbinomial"))
+def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32", _rng=None):
+    """NB(k,p) as Poisson-Gamma mixture (reference: sample_op.cc
+    NegativeBinomialSampler)."""
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    k1, k2 = jax.random.split(_rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(jnp.dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial", num_inputs=0, needs_rng=True,
+          is_random=True,
+          aliases=("random_generalized_negative_binomial", "_sample_gennegbinomial"))
+def random_gen_negative_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", _rng=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    k1, k2 = jax.random.split(_rng)
+    r = 1.0 / alpha
+    g = jax.random.gamma(k1, r, shape) * (mu * alpha)
+    return jax.random.poisson(k2, g, shape).astype(jnp.dtype(dtype))
+
+
+@register("_sample_multinomial", num_inputs=1, needs_rng=True, is_random=True,
+          aliases=("sample_multinomial",))
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", _rng=None):
+    """Sample categorical ids from probability rows (reference:
+    src/operator/random/multisample_op.cc-era sampling; used by SAP too)."""
+    n = 1
+    if shape:
+        n = int(shape) if isinstance(shape, int) else int(jnp.prod(jnp.array(shape)))
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    out = jax.random.categorical(_rng, logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    out = jnp.moveaxis(out, 0, -1)
+    if n == 1:
+        out = out.squeeze(-1)
+    out = out.astype(jnp.dtype(dtype))
+    if get_prob:
+        p = jnp.take_along_axis(
+            data, out[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+        return out, jnp.log(p)
+    return out
+
+
+@register("shuffle", num_inputs=1, needs_rng=True, is_random=True,
+          aliases=("_shuffle",))
+def shuffle(data, _rng=None):
+    return jax.random.permutation(_rng, data, axis=0)

@@ -1,0 +1,105 @@
+"""Reduction ops.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op_value.cc`` and
+``broadcast_reduce_op_index.cc`` (sum/mean/prod/max/min/argmax/argmin/norm,
+with ``axis``/``keepdims``/``exclude`` attrs — SURVEY.md §2.5). The reference
+implements these with cub/mshadow reduction kernels; XLA's reduce HLO replaces
+all of them.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    """Normalize MXNet axis attr: None/() = all axes; int or tuple; exclude
+    inverts the set (reference: broadcast_reduce_op.h ReduceAxesShapeImpl)."""
+    if axis is None or axis == ():
+        ax = tuple(range(ndim))
+        return None if not exclude else ()
+    if isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(int(a) for a in axis)
+    ax = tuple(a % ndim for a in ax)
+    if exclude:
+        ax = tuple(a for a in range(ndim) if a not in ax)
+    return ax
+
+
+def _make_reduce(name, jfn, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(data, axis=None, keepdims=False, exclude=False, _jfn=jfn):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return _jfn(data, axis=ax, keepdims=bool(keepdims))
+    _op.__doc__ = (
+        "Reduce-%s over axes (reference: src/operator/tensor/"
+        "broadcast_reduce_op_value.cc)." % name
+    )
+    return _op
+
+
+_make_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_make_reduce("mean", jnp.mean)
+_make_reduce("prod", jnp.prod)
+_make_reduce("nansum", jnp.nansum)
+_make_reduce("nanprod", jnp.nanprod)
+_make_reduce("max", jnp.max, aliases=("max_axis",))
+_make_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    """Index of max along axis (reference: broadcast_reduce_op_index.cc).
+    Matches the reference's float output dtype."""
+    out = jnp.argmax(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    """argmax over the last axis of 2-D input (reference:
+    broadcast_reduce_op_index.cc argmax_channel; used by metrics)."""
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    """L2 norm reduction (reference: broadcast_reduce_op_value.cc norm —
+    the 0.11 op reduces over all axes; axis is a TPU-build extension)."""
+    ax = _norm_axis(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=None, size=None):
+    """Broadcast along given axes of size-1 dims (reference: matrix_op.cc
+    broadcast_axis)."""
+    if axis is None:
+        return data
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[a % data.ndim] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=None):
+    """Broadcast to target shape; zeros in shape keep the input dim
+    (reference: matrix_op.cc broadcast_to)."""
+    tgt = tuple(
+        d if s == 0 else s for s, d in zip(shape, data.shape)
+    ) if len(shape) == data.ndim else tuple(shape)
+    return jnp.broadcast_to(data, tgt)

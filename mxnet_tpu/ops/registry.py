@@ -1,0 +1,132 @@
+"""Operator registry — the NNVM ``Op`` registry analogue (SURVEY.md §2.9).
+
+In the reference, every operator registers an ``FCompute<cpu/gpu>`` kernel plus
+declarative attributes (``FInferShape``, ``FInferType``, ``FGradient``, ...)
+into the NNVM registry (reference: include/mxnet/op_attr_types.h:44-228,
+src/operator/tensor/elemwise_binary_op_basic.cc:40-104). On TPU the design
+collapses:
+
+* **FCompute** -> a pure JAX function over ``jax.Array`` operands. XLA codegen
+  replaces the hand-written cpu/gpu kernel twins.
+* **FInferShape/FInferType** -> ``jax.eval_shape`` of the same function; no
+  per-op rules to maintain.
+* **FGradient** -> ``jax.vjp`` of the same function; no per-op backward
+  registrations.
+* **FResourceRequest (PRNG)** -> ops that sample declare ``needs_rng`` and are
+  handed an explicit ``jax.random`` key by the dispatch layer.
+
+So one pure function per op carries the entire contract. The registry is the
+single source of truth from which both the imperative ``mx.nd.*`` wrappers and
+the symbolic ``mx.sym.*`` wrappers are auto-generated, exactly like the
+reference's ``_init_ndarray_module``/``_init_symbol_module`` generate wrappers
+from the C op registry (reference: python/mxnet/ndarray.py, symbol.py tails).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["OpDef", "register", "alias", "get_op", "list_ops", "OP_REGISTRY"]
+
+
+class OpDef:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (matches the reference op name where one exists).
+    fn : pure function ``fn(*arrays, **attrs) -> array | tuple``; arrays are
+        jax values, attrs are hashable python values.
+    num_inputs : fixed input arity, or ``None`` for variadic (e.g. concat).
+    needs_rng : if True, dispatch passes attr ``_rng`` (a jax PRNG key).
+    is_random : sampler ops (excluded from gradient tracing).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        num_inputs: Optional[int] = 1,
+        needs_rng: bool = False,
+        is_random: bool = False,
+        doc: Optional[str] = None,
+    ):
+        self.name = name
+        self.fn = fn
+        self.num_inputs = num_inputs
+        self.needs_rng = needs_rng
+        self.is_random = is_random
+        self.__doc__ = doc or fn.__doc__
+        self.aliases: List[str] = [name]
+        # Aux-state protocol (BatchNorm-style, SURVEY.md §2.5): the op takes
+        # `num_aux` auxiliary-state arrays as trailing inputs and returns
+        # `num_aux` updated aux values as trailing outputs for the caller to
+        # commit. `num_hidden_outputs` are extra forward outputs (before the
+        # aux tail) hidden from the user unless an attr exposes them.
+        self.num_aux: int = 0
+        self.num_hidden_outputs: int = 0
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(
+    name: Optional[str] = None,
+    num_inputs: Optional[int] = 1,
+    aliases: Sequence[str] = (),
+    needs_rng: bool = False,
+    is_random: bool = False,
+):
+    """Decorator: register a pure JAX function as a framework op.
+
+    ``@register("dot", num_inputs=2)`` mirrors ``NNVM_REGISTER_OP(dot)``
+    (reference: src/operator/tensor/matrix_op.cc).
+    """
+
+    def _reg(fn: Callable) -> OpDef:
+        opname = name or fn.__name__
+        op = OpDef(opname, fn, num_inputs=num_inputs, needs_rng=needs_rng,
+                   is_random=is_random)
+        if opname in OP_REGISTRY:
+            raise ValueError("Op %s already registered" % opname)
+        OP_REGISTRY[opname] = op
+        for a in aliases:
+            if a in OP_REGISTRY:
+                raise ValueError("Op alias %s already registered" % a)
+            OP_REGISTRY[a] = op
+            op.aliases.append(a)
+        functools.update_wrapper(op, fn, updated=())
+        return op
+
+    return _reg
+
+
+def alias(existing: str, *names: str) -> None:
+    """Add alias names for an already-registered op (the reference does this
+    via add_alias, e.g. elemwise_add a.k.a. _plus — reference:
+    src/operator/tensor/elemwise_binary_op_basic.cc:40)."""
+    op = OP_REGISTRY[existing]
+    for n in names:
+        if n in OP_REGISTRY and OP_REGISTRY[n] is not op:
+            raise ValueError("Op alias %s already registered" % n)
+        OP_REGISTRY[n] = op
+        op.aliases.append(n)
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            "Operator %r not registered (have %d ops)" % (name, len(OP_REGISTRY))
+        ) from None
+
+
+def list_ops() -> List[str]:
+    return sorted(OP_REGISTRY.keys())

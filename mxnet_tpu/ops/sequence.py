@@ -1,0 +1,50 @@
+"""Sequence ops (TNC layout, optional per-batch lengths).
+
+Reference: ``src/operator/sequence_last.cc``, ``sequence_mask.cc``,
+``sequence_reverse.cc`` (SURVEY.md §5.7). Layout matches the reference:
+axis 0 = time, axis 1 = batch. All lowerings are gather/select HLOs with
+static shapes — no dynamic control flow, so they compose with scan/jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("SequenceLast", num_inputs=None, aliases=("sequence_last",))
+def sequence_last(data, sequence_length=None, use_sequence_length=False):
+    """Last valid timestep per batch element (reference: sequence_last.cc)."""
+    if not use_sequence_length or sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1).clip(0, data.shape[0] - 1)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+    )[0]
+
+
+@register("SequenceMask", num_inputs=None, aliases=("sequence_mask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0):
+    """Zero (or `value`) out steps beyond each sequence's length (reference:
+    sequence_mask.cc)."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    t = jnp.arange(data.shape[0]).reshape((-1, 1) + (1,) * (data.ndim - 2))
+    keep = t < sequence_length.astype(jnp.int32).reshape(
+        (1, -1) + (1,) * (data.ndim - 2))
+    return jnp.where(keep, data, jnp.array(value, data.dtype))
+
+
+@register("SequenceReverse", num_inputs=None, aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False):
+    """Reverse along time, respecting per-sequence lengths (reference:
+    sequence_reverse.cc)."""
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    t = jnp.arange(T).reshape((-1, 1))
+    L = sequence_length.astype(jnp.int32).reshape((1, -1))
+    src = jnp.where(t < L, L - 1 - t, t)  # within length: mirrored; after: keep
+    src = src.reshape((T, -1) + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, src, axis=0)

@@ -1,0 +1,40 @@
+"""Global PRNG state for the imperative API.
+
+Reference: ``python/mxnet/random.py`` (mx.random.seed) over per-device mtrand
+resources (src/resource.cc:84-180). The TPU build keeps one counter-based
+threefry key chain: ``seed()`` resets it, every sampler op consumes one split.
+Unlike the reference's per-GPU streams, results are reproducible regardless of
+which device or mesh runs the op.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key"]
+
+_state = threading.local()
+
+
+def _key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state: int) -> None:
+    """Seed the global generator (reference: python/mxnet/random.py seed;
+    MXRandomSeed in src/c_api/c_api.cc)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split off a fresh key for one sampler-op invocation."""
+    k, sub = jax.random.split(_key())
+    _state.key = k
+    return sub
+
+
+def current_key():
+    return _key()

@@ -1,0 +1,20 @@
+"""Test configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the suite runs on CPU
+with a *virtual 8-device mesh* so all multi-device/sharding machinery is
+exercised without TPU hardware — the TPU analogue of the reference running
+multi-device tests on cpu(0)/cpu(1) (tests/python/unittest/
+test_multi_device_exec.py) and its localhost "fake cluster" pattern.
+
+Must set XLA flags before jax initializes.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,147 @@
+"""Autograd tests (modeled on reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + 2 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2, rtol=1e-5)
+
+
+def test_chain_rule_through_ops():
+    x = mx.nd.array(np.random.rand(3, 4).astype("f"))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(mx.nd.sum(x * x))
+    y.backward()
+    xe = x.asnumpy()
+    expected = 2 * xe * np.exp((xe * xe).sum())
+    np.testing.assert_allclose(x.grad.asnumpy(), expected, rtol=1e-3)
+
+
+def test_head_grads():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = 3 * x
+    y.backward(mx.nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0], rtol=1e-5)
+
+
+def test_grad_req_add_and_null():
+    x = mx.nd.array([1.0, 2.0])
+    gx = mx.nd.zeros((2,))
+    ag.mark_variables([x], [gx], grad_reqs="add")
+    with ag.record():
+        y = x * 2
+    y.backward()
+    with ag.record():
+        y = x * 3
+    y.backward()
+    np.testing.assert_allclose(gx.asnumpy(), [5.0, 5.0], rtol=1e-5)
+
+    z = mx.nd.array([1.0])
+    gz = mx.nd.zeros((1,))
+    ag.mark_variables([z], [gz], grad_reqs="null")
+    with ag.record():
+        w = z * 5
+    w.backward()
+    np.testing.assert_allclose(gz.asnumpy(), [0.0])
+
+
+def test_multiple_variables():
+    a = mx.nd.array([2.0])
+    b = mx.nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4.0], rtol=1e-5)  # b + 1
+    np.testing.assert_allclose(b.grad.asnumpy(), [2.0], rtol=1e-5)  # a
+
+
+def test_training_mode_flags():
+    assert not ag.is_training()
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_training()
+        assert ag.is_recording()
+        with ag.pause():
+            assert not ag.is_recording()
+            assert not ag.is_training()
+    with ag.record(train_mode=False):
+        assert ag.is_recording()
+        assert not ag.is_training()
+    with ag.train_mode():
+        assert ag.is_training()
+
+
+def test_retain_graph():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), g1)
+
+
+def test_dropout_replay_consistency():
+    # The vjp replay must reuse the recorded dropout mask (captured rng key)
+    x = mx.nd.array(np.ones((50, 50), dtype="f"))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.Dropout(x, p=0.5)
+    y.backward()
+    g = x.grad.asnumpy()
+    ynp = y.asnumpy()
+    # gradient nonzero exactly where the forward kept the unit
+    np.testing.assert_allclose((g != 0), (ynp != 0))
+
+
+def test_softmax_output_backward_semantics():
+    # SoftmaxOutput backward = (p - onehot) regardless of head grads
+    x = mx.nd.array(np.random.randn(4, 5).astype("f"))
+    label = mx.nd.array([0, 1, 2, 3])
+    x.attach_grad()
+    with ag.record():
+        out = mx.nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = out.asnumpy()
+    oh = np.zeros((4, 5), dtype="f")
+    oh[np.arange(4), [0, 1, 2, 3]] = 1
+    np.testing.assert_allclose(x.grad.asnumpy(), p - oh, rtol=1e-4, atol=1e-6)
+
+
+def test_stochastic_activation_pruning_backward():
+    # reference backward: d_act = grad * mask, d_prob = 0
+    # (stochastic_activation_pruning-inl.h:139-178)
+    act = mx.nd.array(np.random.rand(4, 20).astype("f") + 1)
+    prob = mx.nd.array(np.full((4, 20), 0.05, dtype="f"))
+    act.attach_grad()
+    prob.attach_grad()
+    with ag.record():
+        out = mx.nd.stochastic_activation_pruning(act, prob, frac=0.5)
+    out.backward()
+    mask = out.asnumpy() / act.asnumpy()  # recovers mask since out = act*mask
+    np.testing.assert_allclose(act.grad.asnumpy(), mask, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(prob.grad.asnumpy(), 0.0, atol=1e-6)
+
+
+def test_attach_grad_detach():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * 2).detach()  # detach cuts the graph
+        z = x * 3
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0], rtol=1e-5)

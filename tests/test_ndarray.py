@@ -1,0 +1,149 @@
+"""NDArray unit tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_creation():
+    a = mx.nd.array([[1, 2, 3], [4, 5, 6]])
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    assert a.size == 6
+    np.testing.assert_allclose(a.asnumpy(), [[1, 2, 3], [4, 5, 6]])
+
+
+def test_zeros_ones_full_arange():
+    assert mx.nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert mx.nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_allclose(mx.nd.full((2,), 3.5).asnumpy(), [3.5, 3.5])
+    np.testing.assert_allclose(mx.nd.arange(0, 5).asnumpy(), np.arange(5, dtype="f"))
+    np.testing.assert_allclose(
+        mx.nd.arange(0, 3, repeat=2).asnumpy(), [0, 0, 1, 1, 2, 2])
+
+
+def test_elementwise():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a + 1).asnumpy(), [2, 3, 4])
+    np.testing.assert_allclose((1 - a).asnumpy(), [0, -1, -2])
+    np.testing.assert_allclose((2 ** a).asnumpy(), [2, 4, 8])
+    np.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+
+
+def test_inplace_arith():
+    a = mx.nd.array([1.0, 2.0])
+    aid = id(a)
+    a += 1
+    a *= 2
+    assert id(a) == aid
+    np.testing.assert_allclose(a.asnumpy(), [4, 6])
+
+
+def test_comparisons():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[1:3].asnumpy(), np.arange(12).reshape(3, 4)[1:3])
+    a[1] = 0.0
+    assert a.asnumpy()[1].sum() == 0
+    a[:] = 7.0
+    assert (a.asnumpy() == 7).all()
+
+
+def test_reshape_special_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((24,)).shape == (24,)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert mx.nd.reshape(a, shape=(0, -1)).shape == (2, 12)
+    assert mx.nd.reshape(a, shape=(-2,)).shape == (2, 3, 4)
+    assert mx.nd.reshape(a, shape=(-3, 4)).shape == (6, 4)
+    assert mx.nd.reshape(a, shape=(-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+
+
+def test_copy_and_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu(0))
+    assert a.context.device_type in ("cpu", "tpu")
+    b = a.copyto(mx.cpu(0))
+    np.testing.assert_allclose(b.asnumpy(), a.asnumpy())
+    c = mx.nd.zeros((2, 2))
+    a.copyto(c)
+    np.testing.assert_allclose(c.asnumpy(), a.asnumpy())
+    d = a.as_in_context(mx.cpu(0))
+    assert d.shape == (2, 2)
+
+
+def test_astype():
+    a = mx.nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype(np.float16)
+    assert c.dtype == np.float16
+
+
+def test_wait_and_waitall():
+    a = mx.nd.ones((4, 4))
+    b = a * 2
+    b.wait_to_read()
+    mx.nd.waitall()
+    assert b.asnumpy().sum() == 32
+
+
+def test_dot():
+    a = mx.nd.array(np.random.rand(3, 4).astype("f"))
+    b = mx.nd.array(np.random.rand(4, 5).astype("f"))
+    out = mx.nd.dot(a, b)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    out_t = mx.nd.dot(a, mx.nd.array(b.asnumpy().T), transpose_b=True)
+    np.testing.assert_allclose(out_t.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_save_load_list_and_dict(tmp_path):
+    fname = str(tmp_path / "test.params")
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([[3.0]])
+    mx.nd.save(fname, [a, b])
+    la, lb = mx.nd.load(fname)
+    np.testing.assert_allclose(la.asnumpy(), a.asnumpy())
+    np.testing.assert_allclose(lb.asnumpy(), b.asnumpy())
+    mx.nd.save(fname, {"w": a, "b": b})
+    d = mx.nd.load(fname)
+    assert set(d.keys()) == {"w", "b"}
+    np.testing.assert_allclose(d["w"].asnumpy(), a.asnumpy())
+
+
+def test_concatenate_and_split():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    parts = mx.nd.split(mx.nd.array(np.arange(12).reshape(2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3
+    assert parts[0].shape == (2, 2)
+
+
+def test_broadcast_ops():
+    a = mx.nd.array(np.ones((2, 1)))
+    b = mx.nd.array(np.arange(3).reshape(1, 3))
+    out = mx.nd.broadcast_add(a, b)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(out.asnumpy(), 1 + np.arange(3) * np.ones((2, 1)))
+
+
+def test_ndarray_onehot_encode():
+    idx = mx.nd.array([0, 2])
+    out = mx.nd.zeros((2, 3))
+    mx.nd.onehot_encode(idx, out)
+    np.testing.assert_allclose(out.asnumpy(), [[1, 0, 0], [0, 0, 1]])

@@ -1,0 +1,366 @@
+"""Per-op forward correctness vs numpy oracle (modeled on reference
+tests/python/unittest/test_operator.py — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RTOL, ATOL = 1e-5, 1e-6
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, dtype="float32"))
+
+
+def test_unary_ops():
+    x = np.random.rand(3, 4).astype("f") + 0.5
+    nd = _nd(x)
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "abs": np.abs, "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+        "log1p": np.log1p, "expm1": np.expm1, "rsqrt": lambda v: 1 / np.sqrt(v),
+        "reciprocal": lambda v: 1 / v, "negative": lambda v: -v,
+    }
+    for name, fn in cases.items():
+        out = getattr(mx.nd, name)(nd).asnumpy()
+        np.testing.assert_allclose(out, fn(x), rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_activation_types():
+    x = np.random.randn(4, 5).astype("f")
+    nd = _nd(x)
+    np.testing.assert_allclose(
+        mx.nd.Activation(nd, act_type="relu").asnumpy(), np.maximum(x, 0), rtol=RTOL)
+    np.testing.assert_allclose(
+        mx.nd.Activation(nd, act_type="sigmoid").asnumpy(), 1 / (1 + np.exp(-x)),
+        rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.Activation(nd, act_type="tanh").asnumpy(), np.tanh(x), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.Activation(nd, act_type="softrelu").asnumpy(),
+        np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0), rtol=1e-4, atol=1e-6)
+
+
+def test_leaky_relu():
+    x = np.random.randn(3, 4).astype("f")
+    out = mx.nd.LeakyReLU(_nd(x), act_type="leaky", slope=0.1).asnumpy()
+    np.testing.assert_allclose(out, np.where(x >= 0, x, 0.1 * x), rtol=RTOL)
+    out = mx.nd.LeakyReLU(_nd(x), act_type="elu", slope=1.0).asnumpy()
+    np.testing.assert_allclose(out, np.where(x >= 0, x, np.expm1(x)), rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_ops():
+    x = np.random.randn(4, 10).astype("f")
+    def np_softmax(v, axis=-1):
+        e = np.exp(v - v.max(axis=axis, keepdims=True))
+        return e / e.sum(axis=axis, keepdims=True)
+    np.testing.assert_allclose(
+        mx.nd.softmax(_nd(x)).asnumpy(), np_softmax(x), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.log_softmax(_nd(x)).asnumpy(), np.log(np_softmax(x)), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.SoftmaxActivation(_nd(x)).asnumpy(), np_softmax(x), rtol=1e-4, atol=1e-6)
+
+
+def test_fully_connected():
+    x = np.random.rand(5, 8).astype("f")
+    w = np.random.rand(3, 8).astype("f")
+    b = np.random.rand(3).astype("f")
+    out = mx.nd.FullyConnected(_nd(x), _nd(w), _nd(b), num_hidden=3).asnumpy()
+    np.testing.assert_allclose(out, x @ w.T + b, rtol=1e-4, atol=1e-5)
+    out = mx.nd.FullyConnected(_nd(x), _nd(w), no_bias=True, num_hidden=3).asnumpy()
+    np.testing.assert_allclose(out, x @ w.T, rtol=1e-4, atol=1e-5)
+    # 4-d input flattens
+    x4 = np.random.rand(5, 2, 2, 2).astype("f")
+    out = mx.nd.FullyConnected(_nd(x4), _nd(w), _nd(b), num_hidden=3).asnumpy()
+    np.testing.assert_allclose(out, x4.reshape(5, 8) @ w.T + b, rtol=1e-4, atol=1e-5)
+
+
+def _np_conv2d(x, w, b, stride, pad):
+    n, c, h, ww = x.shape
+    f, _, kh, kw = w.shape
+    sh, sw = stride
+    xp = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+    oh = (h + 2 * pad[0] - kh) // sh + 1
+    ow = (ww + 2 * pad[1] - kw) // sw + 1
+    out = np.zeros((n, f, oh, ow), dtype=x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = np.einsum("nchw,fchw->nf", patch, w)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def test_convolution():
+    x = np.random.rand(2, 3, 8, 8).astype("f")
+    w = np.random.rand(4, 3, 3, 3).astype("f")
+    b = np.random.rand(4).astype("f")
+    out = mx.nd.Convolution(_nd(x), _nd(w), _nd(b), kernel=(3, 3), num_filter=4,
+                            stride=(1, 1), pad=(1, 1)).asnumpy()
+    exp = _np_conv2d(x, w, b, (1, 1), (1, 1))
+    np.testing.assert_allclose(out, exp, rtol=1e-3, atol=1e-4)
+    out = mx.nd.Convolution(_nd(x), _nd(w), kernel=(3, 3), num_filter=4,
+                            stride=(2, 2), no_bias=True).asnumpy()
+    exp = _np_conv2d(x, w, None, (2, 2), (0, 0))
+    np.testing.assert_allclose(out, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_pooling():
+    x = np.random.rand(2, 3, 6, 6).astype("f")
+    out = mx.nd.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
+    exp = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, exp, rtol=RTOL)
+    out = mx.nd.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2), pool_type="avg").asnumpy()
+    exp = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-6)
+    out = mx.nd.Pooling(_nd(x), global_pool=True, pool_type="max").asnumpy()
+    np.testing.assert_allclose(out, x.max(axis=(2, 3), keepdims=True), rtol=RTOL)
+
+
+def test_batchnorm_train_and_eval():
+    np.random.seed(0)
+    x = np.random.rand(4, 3, 2, 2).astype("f")
+    gamma = np.ones(3, dtype="f")
+    beta = np.zeros(3, dtype="f")
+    mm = mx.nd.zeros((3,))
+    mv = mx.nd.ones((3,))
+    with mx.autograd.record(train_mode=True):
+        out = mx.nd.BatchNorm(_nd(x), _nd(gamma), _nd(beta), mm, mv,
+                              fix_gamma=False, momentum=0.9)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    exp = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-3)
+    np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-3, atol=1e-4)
+    # aux states updated
+    np.testing.assert_allclose(mm.asnumpy(), 0.1 * mean, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mv.asnumpy(), 0.9 + 0.1 * var, rtol=1e-4, atol=1e-5)
+    # eval mode uses moving stats
+    out_eval = mx.nd.BatchNorm(_nd(x), _nd(gamma), _nd(beta), mm, mv,
+                               fix_gamma=False)
+    exp_eval = (x - mm.asnumpy().reshape(1, 3, 1, 1)) / np.sqrt(
+        mv.asnumpy().reshape(1, 3, 1, 1) + 1e-3)
+    np.testing.assert_allclose(out_eval.asnumpy(), exp_eval, rtol=1e-3, atol=1e-4)
+
+
+def test_dropout_modes():
+    x = np.ones((100, 100), dtype="f")
+    # eval = identity
+    out = mx.nd.Dropout(_nd(x), p=0.5).asnumpy()
+    np.testing.assert_allclose(out, x)
+    with mx.autograd.record(train_mode=True):
+        out = mx.nd.Dropout(_nd(x), p=0.5).asnumpy()
+    frac = (out == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype("f")
+    nd = _nd(x)
+    np.testing.assert_allclose(mx.nd.sum(nd).asnumpy(), x.sum(), rtol=1e-4)
+    np.testing.assert_allclose(
+        mx.nd.sum(nd, axis=1).asnumpy(), x.sum(axis=1), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.sum(nd, axis=(0, 2), keepdims=True).asnumpy(),
+        x.sum(axis=(0, 2), keepdims=True), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.sum(nd, axis=1, exclude=True).asnumpy(), x.sum(axis=(0, 2)),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mx.nd.mean(nd, axis=0).asnumpy(), x.mean(axis=0),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mx.nd.max(nd, axis=2).asnumpy(), x.max(axis=2), rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.argmax(nd, axis=1).asnumpy(),
+                               x.argmax(axis=1), rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.norm(nd).asnumpy(),
+                               np.sqrt((x ** 2).sum()), rtol=1e-4)
+
+
+def test_matrix_ops():
+    x = np.random.rand(2, 3, 4).astype("f")
+    nd = _nd(x)
+    np.testing.assert_allclose(mx.nd.transpose(nd).asnumpy(), x.T, rtol=RTOL)
+    np.testing.assert_allclose(
+        mx.nd.transpose(nd, axes=(1, 0, 2)).asnumpy(), x.transpose(1, 0, 2), rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.swapaxes(nd, dim1=0, dim2=2).asnumpy(),
+                               x.swapaxes(0, 2), rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.expand_dims(nd, axis=1).asnumpy(),
+                               x[:, None], rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.flip(nd, axis=2).asnumpy(),
+                               x[:, :, ::-1], rtol=RTOL)
+    np.testing.assert_allclose(
+        mx.nd.slice(nd, begin=(0, 1, None), end=(2, 3, None)).asnumpy(),
+        x[0:2, 1:3, :], rtol=RTOL)
+    np.testing.assert_allclose(
+        mx.nd.slice_axis(nd, axis=2, begin=1, end=3).asnumpy(), x[:, :, 1:3], rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.tile(nd, reps=(1, 2, 1)).asnumpy(),
+                               np.tile(x, (1, 2, 1)), rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.repeat(nd, repeats=2, axis=1).asnumpy(),
+                               np.repeat(x, 2, axis=1), rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.clip(nd, a_min=0.2, a_max=0.8).asnumpy(),
+                               np.clip(x, 0.2, 0.8), rtol=RTOL)
+
+
+def test_batch_dot():
+    a = np.random.rand(4, 3, 5).astype("f")
+    b = np.random.rand(4, 5, 2).astype("f")
+    out = mx.nd.batch_dot(_nd(a), _nd(b)).asnumpy()
+    np.testing.assert_allclose(out, np.einsum("bij,bjk->bik", a, b), rtol=1e-4, atol=1e-5)
+    out = mx.nd.batch_dot(_nd(a.transpose(0, 2, 1)), _nd(b), transpose_a=True).asnumpy()
+    np.testing.assert_allclose(out, np.einsum("bij,bjk->bik", a, b), rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_take_pick_onehot():
+    w = np.random.rand(10, 4).astype("f")
+    idx = np.array([1, 3, 5], dtype="f")
+    out = mx.nd.Embedding(_nd(idx), _nd(w), input_dim=10, output_dim=4).asnumpy()
+    np.testing.assert_allclose(out, w[[1, 3, 5]], rtol=RTOL)
+    out = mx.nd.take(_nd(w), _nd(idx)).asnumpy()
+    np.testing.assert_allclose(out, w[[1, 3, 5]], rtol=RTOL)
+    data = np.random.rand(3, 5).astype("f")
+    pidx = np.array([0, 2, 4], dtype="f")
+    out = mx.nd.pick(_nd(data), _nd(pidx)).asnumpy()
+    np.testing.assert_allclose(out, data[np.arange(3), [0, 2, 4]], rtol=RTOL)
+    out = mx.nd.one_hot(_nd(idx), depth=10).asnumpy()
+    exp = np.zeros((3, 10), dtype="f")
+    exp[np.arange(3), [1, 3, 5]] = 1
+    np.testing.assert_allclose(out, exp, rtol=RTOL)
+
+
+def test_ordering_ops():
+    x = np.random.rand(4, 6).astype("f")
+    np.testing.assert_allclose(mx.nd.sort(_nd(x), axis=1).asnumpy(),
+                               np.sort(x, axis=1), rtol=RTOL)
+    np.testing.assert_allclose(
+        mx.nd.sort(_nd(x), axis=1, is_ascend=False).asnumpy(),
+        -np.sort(-x, axis=1), rtol=RTOL)
+    np.testing.assert_allclose(mx.nd.argsort(_nd(x), axis=1).asnumpy(),
+                               np.argsort(x, axis=1), rtol=RTOL)
+    vals = mx.nd.topk(_nd(x), k=2, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(vals, -np.sort(-x, axis=1)[:, :2], rtol=RTOL)
+    idxs = mx.nd.topk(_nd(x), k=1).asnumpy()
+    np.testing.assert_allclose(idxs.ravel(), x.argmax(axis=1), rtol=RTOL)
+
+
+def test_where():
+    cond = np.array([[1, 0], [0, 1]], dtype="f")
+    x = np.ones((2, 2), dtype="f")
+    y = np.zeros((2, 2), dtype="f")
+    out = mx.nd.where(_nd(cond), _nd(x), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out, cond)
+
+
+def test_sequence_ops():
+    # TNC layout: T=4, N=2, C=3
+    x = np.random.rand(4, 2, 3).astype("f")
+    lengths = np.array([2, 4], dtype="f")
+    out = mx.nd.SequenceLast(_nd(x), _nd(lengths), use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(out[0], x[1, 0], rtol=RTOL)
+    np.testing.assert_allclose(out[1], x[3, 1], rtol=RTOL)
+    out = mx.nd.SequenceMask(_nd(x), _nd(lengths), use_sequence_length=True,
+                             value=-1.0).asnumpy()
+    np.testing.assert_allclose(out[:2, 0], x[:2, 0], rtol=RTOL)
+    assert (out[2:, 0] == -1).all()
+    np.testing.assert_allclose(out[:, 1], x[:, 1], rtol=RTOL)
+    out = mx.nd.SequenceReverse(_nd(x), _nd(lengths), use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(out[0, 0], x[1, 0], rtol=RTOL)
+    np.testing.assert_allclose(out[1, 0], x[0, 0], rtol=RTOL)
+    np.testing.assert_allclose(out[2:, 0], x[2:, 0], rtol=RTOL)
+    np.testing.assert_allclose(out[:, 1], x[::-1, 1], rtol=RTOL)
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = mx.nd.random_uniform(low=0, high=1, shape=(1000,)).asnumpy()
+    assert 0 <= a.min() and a.max() <= 1
+    assert abs(a.mean() - 0.5) < 0.05
+    mx.random.seed(42)
+    b = mx.nd.random_uniform(low=0, high=1, shape=(1000,)).asnumpy()
+    np.testing.assert_allclose(a, b)  # reproducible
+    n = mx.nd.random_normal(loc=2.0, scale=0.5, shape=(2000,)).asnumpy()
+    assert abs(n.mean() - 2.0) < 0.1
+    assert abs(n.std() - 0.5) < 0.1
+
+
+def test_sample_multinomial():
+    mx.random.seed(0)
+    p = mx.nd.array([[0.0, 0.0, 1.0], [1.0, 0.0, 0.0]])
+    s = mx.nd.sample_multinomial(p).asnumpy()
+    np.testing.assert_allclose(s, [2, 0])
+
+
+def test_stochastic_activation_pruning():
+    mx.random.seed(0)
+    act = np.random.rand(8, 100).astype("f") + 1.0
+    prob = np.abs(act) / np.abs(act).sum(axis=1, keepdims=True)
+    out = mx.nd.stochastic_activation_pruning(_nd(act), _nd(prob), frac=0.5).asnumpy()
+    # zeros where pruned; kept values rescaled upward
+    assert (out == 0).any()
+    kept = out != 0
+    assert kept.sum() > 0
+    # kept entries equal act * weight, weight >= 1
+    ratio = out[kept] / act[kept]
+    assert (ratio >= 1.0 - 1e-5).all()
+    # frac=1.0 keeps expectation approximately unbiased
+    out_full = mx.nd.stochastic_activation_pruning(_nd(act), _nd(prob), frac=1.0).asnumpy()
+    assert (out_full != 0).mean() > 0.3
+
+
+def test_loss_head_forwards():
+    x = np.random.randn(4, 5).astype("f")
+    label = np.array([0, 1, 2, 3], dtype="f")
+    out = mx.nd.SoftmaxOutput(_nd(x), _nd(label)).asnumpy()
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(axis=1, keepdims=True), rtol=1e-4, atol=1e-6)
+    out = mx.nd.LinearRegressionOutput(_nd(x), _nd(x)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=RTOL)
+    out = mx.nd.LogisticRegressionOutput(_nd(x), _nd(x)).asnumpy()
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-x)), rtol=1e-4, atol=1e-6)
+    out = mx.nd.MakeLoss(_nd(x)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=RTOL)
+
+
+def test_lrn():
+    x = np.random.rand(2, 5, 3, 3).astype("f")
+    out = mx.nd.LRN(_nd(x), nsize=3, alpha=1e-4, beta=0.75, knorm=2.0).asnumpy()
+    # oracle
+    sq = x ** 2
+    exp = np.zeros_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        ssum = sq[:, lo:hi].sum(axis=1)
+        exp[:, c] = x[:, c] / ((2.0 + 1e-4 * ssum / 3) ** 0.75)
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_l2_normalization():
+    x = np.random.rand(3, 4).astype("f")
+    out = mx.nd.L2Normalization(_nd(x), mode="instance").asnumpy()
+    np.testing.assert_allclose(
+        out, x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10), rtol=1e-4)
+
+
+def test_cast_and_bf16():
+    x = np.random.rand(4, 4).astype("f")
+    out = mx.nd.Cast(_nd(x), dtype="bfloat16")
+    assert str(out.dtype) == "bfloat16"
+    back = out.astype("float32").asnumpy()
+    np.testing.assert_allclose(back, x, rtol=1e-2)
+
+
+def test_deconvolution_shape():
+    x = np.random.rand(1, 4, 5, 5).astype("f")
+    w = np.random.rand(4, 6, 3, 3).astype("f")  # (C_in, F, kh, kw)
+    out = mx.nd.Deconvolution(_nd(x), _nd(w), kernel=(3, 3), stride=(2, 2),
+                              num_filter=6, no_bias=True)
+    assert out.shape == (1, 6, 11, 11)  # (5-1)*2 + 3 = 11
+    # adjoint check: deconv(x) dot y == x dot conv(y)
+    y = np.random.rand(1, 6, 11, 11).astype("f")
+    conv_y = mx.nd.Convolution(_nd(y), _nd(w), kernel=(3, 3), stride=(2, 2),
+                               num_filter=4, no_bias=True).asnumpy()
+    lhs = (out.asnumpy() * y).sum()
+    rhs = (x * conv_y).sum()
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-3)
