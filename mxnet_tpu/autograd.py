@@ -11,11 +11,30 @@ tape re-traces every recorded op with its captured attrs (including the exact
 PRNG keys, so dropout masks replay identically) and lets XLA differentiate,
 fuse and schedule the whole backward — the reference's per-op FGradient
 registrations and backward executor disappear.
+
+Versioned tape: every NDArray carries a process-unique ``_uid`` plus a
+``_version`` counter bumped on each in-place rebind of its buffer
+(``x[:] = v``, ``x += y``, ``out=`` kwargs, aux-state commits). Tape entries
+key their inputs/outputs by ``(uid, version)`` and capture input *values* at
+record time, so:
+
+* gradients are computed at the values the forward actually consumed, even if
+  a variable is mutated after recording (the reference gets this by tracking
+  the autograd node on the array itself);
+* recorded in-place ops (``x *= 2`` routed through ``out=self``) chain
+  correctly through versions instead of silently dropping gradient;
+* uid keys cannot alias after garbage collection (unlike ``id()``).
+
+Entries hold only weak references to their output arrays; dead subgraphs are
+pruned when a new outermost ``record()`` scope begins, so recording without
+ever calling ``backward`` does not leak.
 """
 from __future__ import annotations
 
+import itertools
 import threading
-from typing import List, Optional, Sequence
+import weakref
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,10 +42,16 @@ import jax.numpy as jnp
 __all__ = [
     "record", "pause", "train_mode", "predict_mode", "is_recording",
     "is_training", "mark_variables", "backward", "set_recording",
-    "set_training",
+    "set_training", "Function",
 ]
 
 _state = threading.local()
+_uid_counter = itertools.count()
+
+
+def new_uid() -> int:
+    """Process-unique array id for tape keys (never reused, unlike id())."""
+    return next(_uid_counter)
 
 
 def _st():
@@ -34,26 +59,41 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []
-        _state.marked = {}
+        _state.marked = {}  # uid -> weakref(NDArray)
     return _state
 
 
 class _TapeEntry:
-    __slots__ = ("op", "attrs", "inputs", "input_consts", "outputs")
+    __slots__ = ("op", "attrs", "in_keys", "in_consts", "out_keys", "out_refs")
 
-    def __init__(self, op, attrs, inputs, outputs):
+    def __init__(self, op, attrs, in_keys, in_consts, out_targets):
         self.op = op
         self.attrs = attrs
-        self.inputs = inputs          # list of NDArray refs
-        self.input_consts = [a.data for a in inputs]  # values at record time
-        self.outputs = outputs        # list of NDArray refs
+        self.in_keys = in_keys            # [(uid, version)] at record time
+        self.in_consts = in_consts        # input jax values at record time
+        self.out_keys = [(t._uid, t._version) for t in out_targets]
+        self.out_refs = [weakref.ref(t) for t in out_targets]
 
 
-def _record_op(op, attrs, inputs, outputs) -> None:
+def _record_op(op, attrs, in_keys, in_consts, out_targets) -> None:
     """Called by the imperative dispatch layer for every op executed while
     recording (reference hook: MXImperativeInvoke -> RecordImperativeFCompute,
     src/c_api/c_api_ndarray.cc:400, src/ndarray/autograd.cc:104)."""
-    _st().tape.append(_TapeEntry(op, attrs, list(inputs), list(outputs)))
+    _st().tape.append(_TapeEntry(op, attrs, in_keys, in_consts, out_targets))
+
+
+def _prune_tape(s) -> None:
+    """Drop entries no live array can reach — keeps long-lived processes that
+    record without calling backward from accumulating tape forever."""
+    live_keys = set()
+    keep: List[_TapeEntry] = []
+    for e in reversed(s.tape):
+        if any(r() is not None for r in e.out_refs) or \
+                any(k in live_keys for k in e.out_keys):
+            keep.append(e)
+            live_keys.update(e.in_keys)
+    keep.reverse()
+    s.tape = keep
 
 
 def is_recording() -> bool:
@@ -67,6 +107,8 @@ def is_training() -> bool:
 def set_recording(is_record: bool) -> bool:
     s = _st()
     prev, s.recording = s.recording, is_record
+    if is_record and not prev:
+        _prune_tape(s)
     return prev
 
 
@@ -125,7 +167,7 @@ def mark_variables(variables, gradients, grad_reqs="write") -> None:
     for var, grad, req in zip(variables, gradients, grad_reqs):
         var._grad = grad
         var._grad_req = req
-        s.marked[id(var)] = var
+        s.marked[var._uid] = weakref.ref(var)
 
 
 def backward(heads, head_grads=None, retain_graph: bool = False,
@@ -136,7 +178,9 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     Reconstructs a pure function marked-vars -> heads by replaying the tape,
     then runs one ``jax.vjp``. Gradients land in each variable's attached
     grad buffer honoring its grad_req (write/add/null — reference
-    OpReqType semantics, include/mxnet/op_attr_types.h:45-58).
+    OpReqType semantics, include/mxnet/op_attr_types.h:45-58). All values are
+    the ones recorded at trace time; later mutations of inputs do not change
+    the result (matching the reference's saved-node semantics).
     """
     from .ndarray import NDArray  # cycle-free at call time
 
@@ -146,41 +190,71 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         head_grads = [head_grads]
 
     s = _st()
-    tape: List[_TapeEntry] = s.tape
+    head_keys = [(h._uid, h._version) for h in heads]
 
-    # Which marked variables feed the heads? Walk tape backwards from heads.
-    needed = {id(h) for h in heads}
-    used_entries = []
-    for entry in reversed(tape):
-        if any(id(o) in needed for o in entry.outputs):
-            used_entries.append(entry)
-            needed.update(id(i) for i in entry.inputs)
-    used_entries.reverse()
+    # Backward slice of the tape reaching the heads.
+    needed = set(head_keys)
+    used: List[_TapeEntry] = []
+    for entry in reversed(s.tape):
+        if any(k in needed for k in entry.out_keys):
+            used.append(entry)
+            needed.update(entry.in_keys)
+    used.reverse()
 
-    variables = [v for vid, v in s.marked.items() if vid in needed]
-    if not variables:
+    produced = set()
+    for e in used:
+        produced.update(e.out_keys)
+
+    # Record-time constants per key (first occurrence wins: values at the
+    # version are identical wherever captured).
+    const_of = {}
+    for e in used:
+        for k, c in zip(e.in_keys, e.in_consts):
+            const_of.setdefault(k, c)
+
+    # Seeds: every (uid, version) of a marked variable that the slice consumes
+    # but does not itself produce is a differentiation leaf. A variable
+    # mutated *outside* the tape mid-recording contributes one leaf per
+    # version; gradients of the versions are summed into its grad buffer.
+    seeds = []  # (var, key, primal value)
+    for uid, ref in list(s.marked.items()):
+        var = ref()
+        if var is None:
+            del s.marked[uid]
+            continue
+        for k, c in const_of.items():
+            if k[0] == uid and k not in produced:
+                seeds.append((var, k, c))
+        cur_key = (var._uid, var._version)
+        if cur_key in needed and cur_key not in produced and \
+                all(sk != cur_key for _, sk, _ in seeds):
+            seeds.append((var, cur_key, var._data))
+
+    if not seeds:
         raise ValueError(
             "backward: no marked variables reach the heads — call "
             "mark_variables/attach_grad and compute inside autograd.record()")
 
-    var_ids = [id(v) for v in variables]
-    head_ids = [id(h) for h in heads]
+    seed_keys = [k for _, k, _ in seeds]
 
-    def replay(var_values):
-        env = dict(zip(var_ids, var_values))
-        for entry in used_entries:
-            args = [
-                env.get(id(inp), const)
-                for inp, const in zip(entry.inputs, entry.input_consts)
-            ]
+    def replay(seed_vals):
+        env = dict(zip(seed_keys, seed_vals))
+        for entry in used:
+            args = [env.get(k, c) for k, c in zip(entry.in_keys, entry.in_consts)]
             outs = entry.op.fn(*args, **entry.attrs)
             if not isinstance(outs, tuple):
                 outs = (outs,)
-            for o_nd, o_val in zip(entry.outputs, outs):
-                env[id(o_nd)] = o_val
-        return [env[h] for h in head_ids]
+            for k, v in zip(entry.out_keys, outs):
+                env[k] = v
+        try:
+            return [env[h] for h in head_keys]
+        except KeyError:
+            raise ValueError(
+                "backward: a head was not produced by the recorded graph "
+                "(was it computed outside autograd.record(), or mutated "
+                "in-place after recording?)") from None
 
-    primals = [v.data for v in variables]
+    primals = [p for _, _, p in seeds]
     head_vals, vjp_fn = jax.vjp(lambda *vs: replay(list(vs)), *primals)
     if head_grads is None:
         cts = [jnp.ones_like(h) for h in head_vals]
@@ -191,16 +265,121 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
             for g, h in zip(head_grads, head_vals)
         ]
     grads = vjp_fn(cts)
-    for var, g in zip(variables, grads):
+
+    # Sum per-variable (a var may seed several versions), then commit.
+    acc = {}
+    for (var, _, _), g in zip(seeds, grads):
+        if var._uid in acc:
+            acc[var._uid] = (var, acc[var._uid][1] + g)
+        else:
+            acc[var._uid] = (var, g)
+    for var, g in acc.values():
         req = getattr(var, "_grad_req", "write")
         if req == "null" or var._grad is None:
             continue
         if req == "add":
-            var._grad._data = var._grad.data + g
+            var._grad._data = var._grad.data + g.astype(var._grad.dtype)
         else:
             var._grad._data = g.astype(var._grad.dtype)
+        var._grad._version += 1
+
     if not retain_graph:
-        s.tape = []
+        used_set = set(map(id, used))
+        s.tape = [e for e in s.tape if id(e) not in used_set]
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. ``variables`` without touching their
+    attached grad buffers (reference: later mx.autograd.grad; provided for
+    Gluon-style code)."""
+    from .ndarray import NDArray
+    single = not isinstance(variables, (list, tuple))
+    if single:
+        variables = [variables]
+    saved = [(v._grad, v._grad_req) for v in variables]
+    outs = []
+    try:
+        for v in variables:
+            v._grad = NDArray(jnp.zeros_like(v._data))
+            v._grad_req = "write"
+            _st().marked[v._uid] = weakref.ref(v)
+        backward(heads, head_grads,
+                 retain_graph=True if retain_graph is None else retain_graph,
+                 train_mode=train_mode)
+        outs = [v._grad for v in variables]
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return outs[0] if single else outs
+
+
+class Function:
+    """User-defined differentiable function (reference:
+    python/mxnet/autograd.py:308-424 ``Function`` with forward/backward).
+
+    Subclass and override :meth:`forward` (NDArray computation) and
+    :meth:`backward` (maps output gradients to input gradients). During tape
+    replay the call is wrapped in ``jax.custom_vjp``; ``backward`` may use
+    tensors saved on ``self`` during ``forward`` — the forward is re-run
+    inside the backward trace so the saved state is trace-consistent (the
+    TPU-era equivalent of the reference saving output NDArrays on the node).
+    """
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+        from .ops.registry import OpDef
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        if is_recording():
+            n_in = len(inputs)
+
+            def _run_fwd(*vals):
+                nds = [NDArray(v) for v in vals]
+                with pause():
+                    outs = self.forward(*nds)
+                outs = [outs] if not isinstance(outs, (list, tuple)) else outs
+                return tuple(o._data for o in outs)
+
+            @jax.custom_vjp
+            def fn(*vals):
+                return _run_fwd(*vals)
+
+            def fn_fwd(*vals):
+                return _run_fwd(*vals), vals
+
+            def fn_bwd(res_vals, gs):
+                # Re-run forward so self-saved tensors belong to this trace.
+                nds = [NDArray(v) for v in res_vals]
+                with pause():
+                    self.forward(*nds)
+                    igrads = self.backward(*[NDArray(g) for g in gs])
+                igrads = [igrads] if not isinstance(igrads, (list, tuple)) \
+                    else list(igrads)
+                if len(igrads) != n_in:
+                    raise ValueError(
+                        "Function.backward returned %d gradients for %d inputs"
+                        % (len(igrads), n_in))
+                return tuple(g._data for g in igrads)
+
+            fn.defvjp(fn_fwd, fn_bwd)
+            op = OpDef("_Function_%s" % type(self).__name__, fn,
+                       num_inputs=len(inputs))
+            in_keys = [(a._uid, a._version) for a in inputs]
+            in_consts = [a._data for a in inputs]
+            _record_op(op, {}, in_keys, in_consts, out_list)
+
+        return out_list[0] if single else out_list
 
 
 def get_symbol(x):  # pragma: no cover - reference-API stub

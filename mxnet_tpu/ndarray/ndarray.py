@@ -52,7 +52,8 @@ class NDArray:
     """Multi-device, async n-dimensional array (reference:
     python/mxnet/ndarray.py:138)."""
 
-    __slots__ = ("_data", "_grad", "_grad_req")
+    __slots__ = ("_data", "_grad", "_grad_req", "_uid", "_version",
+                 "__weakref__")
     # numpy should defer to our reflected dunders
     __array_priority__ = 100.0
 
@@ -76,6 +77,9 @@ class NDArray:
         self._data = data
         self._grad: Optional["NDArray"] = None
         self._grad_req: str = "write"
+        # tape identity: unique id + in-place mutation counter (autograd.py)
+        self._uid: int = _autograd.new_uid()
+        self._version: int = 0
 
     # ------------------------------------------------------------ basics
     @property
@@ -154,6 +158,7 @@ class NDArray:
             return NDArray(jax.device_put(self._data, other.jax_device))
         other._data = jax.device_put(
             self._data.astype(other.dtype), other.context.jax_device)
+        other._version += 1
         return other
 
     def as_in_context(self, ctx: Context) -> "NDArray":
@@ -188,8 +193,10 @@ class NDArray:
                 self._data = jnp.broadcast_to(
                     jnp.asarray(val, dtype=self._data.dtype), self.shape
                 ).astype(self._data.dtype)
-            return
-        self._data = self._data.at[key].set(val)
+        else:
+            self._data = self._data.at[key].set(val)
+        # new buffer version: recorded tape entries keep the old value
+        self._version += 1
 
     # ------------------------------------------------------- arithmetic
     def _binop(self, other, opname, scalar_opname, reverse=False):
@@ -238,25 +245,26 @@ class NDArray:
     def __abs__(self):
         return imperative_invoke(get_op("abs"), self)
 
+    def _ibinop(self, o, opname, scalar_opname):
+        # route through out=self so the mutation is a *recorded* tape entry —
+        # gradients chain through in-place updates (reference keeps the AG
+        # node on the array; here the version bump plays that role)
+        if isinstance(o, NDArray):
+            return imperative_invoke(get_op(opname), self, o, out=self)
+        return imperative_invoke(get_op(scalar_opname), self,
+                                 scalar=float(o), out=self)
+
     def __iadd__(self, o):
-        out = self.__add__(o)
-        self._data = out._data
-        return self
+        return self._ibinop(o, "elemwise_add", "_plus_scalar")
 
     def __isub__(self, o):
-        out = self.__sub__(o)
-        self._data = out._data
-        return self
+        return self._ibinop(o, "elemwise_sub", "_minus_scalar")
 
     def __imul__(self, o):
-        out = self.__mul__(o)
-        self._data = out._data
-        return self
+        return self._ibinop(o, "elemwise_mul", "_mul_scalar")
 
     def __itruediv__(self, o):
-        out = self.__truediv__(o)
-        self._data = out._data
-        return self
+        return self._ibinop(o, "elemwise_div", "_div_scalar")
 
     def __eq__(self, o):
         return self._binop(o, "broadcast_equal", "_equal_scalar")
@@ -351,6 +359,14 @@ def imperative_invoke(op: OpDef, *args, out=None, ctx=None, **attrs):
         attrs["_rng"] = _random.next_key()
     if _accepts_is_train(op):
         attrs.setdefault("_is_train", _autograd.is_training())
+
+    recording = _autograd.is_recording() and not op.is_random
+    if recording:
+        # capture pre-mutation identities + values (reference saves node
+        # inputs at record time, src/ndarray/autograd.cc:129-227)
+        in_keys = [(a._uid, a._version) for a in nd_args]
+        in_consts = [a._data for a in nd_args]
+
     if op.num_inputs == 0 and not nd_args:
         dev = (ctx or current_context()).jax_device
         with jax.default_device(dev):
@@ -363,29 +379,40 @@ def imperative_invoke(op: OpDef, *args, out=None, ctx=None, **attrs):
     out_nds = [NDArray(o) for o in outputs]
 
     # aux-state commit (BatchNorm moving stats): trailing num_aux outputs are
-    # written back into the trailing num_aux NDArray inputs.
+    # written back into the trailing num_aux NDArray inputs; the tape entry's
+    # trailing outputs are the aux arrays *at their new version* so replay
+    # chains through the state update.
     if op.num_aux:
         aux_inputs = nd_args[-op.num_aux:]
         for aux_nd, new_val in zip(aux_inputs, out_nds[-op.num_aux:]):
             aux_nd._data = new_val._data
-        out_nds = out_nds[: len(out_nds) - op.num_aux]
-
-    if _autograd.is_recording() and not op.is_random:
-        _autograd._record_op(op, attrs, nd_args, out_nds)
+            aux_nd._version += 1
+        result_nds = out_nds[: len(out_nds) - op.num_aux]
+        tape_targets = result_nds + aux_inputs
+    else:
+        result_nds = out_nds
+        tape_targets = list(out_nds)
 
     # hide extra outputs (e.g. BatchNorm mean/var) unless requested
-    visible = out_nds
+    visible = result_nds
     if op.num_hidden_outputs and not attrs.get("output_mean_var"):
-        visible = out_nds[: len(out_nds) - op.num_hidden_outputs]
+        visible = result_nds[: len(result_nds) - op.num_hidden_outputs]
 
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o, v in zip(outs, visible):
             o._data = v._data
-        return out
-    if len(visible) == 1:
-        return visible[0]
-    return visible
+            o._version += 1
+            tape_targets[tape_targets.index(v)] = o
+        ret = out
+    elif len(visible) == 1:
+        ret = visible[0]
+    else:
+        ret = visible
+
+    if recording:
+        _autograd._record_op(op, attrs, in_keys, in_consts, tape_targets)
+    return ret
 
 
 # --------------------------------------------------------------- helpers
@@ -406,9 +433,14 @@ def empty(shape, ctx=None, dtype="float32") -> NDArray:
 
 
 def waitall() -> None:
-    """Block until all async computation completes (reference:
-    Engine::WaitForAll via MXNDArrayWaitAll; python/mxnet/ndarray.py:131)."""
-    (jax.device_put(0.0) + 0).block_until_ready()
+    """Block until all async computation completes on *every* device
+    (reference: Engine::WaitForAll via MXNDArrayWaitAll;
+    python/mxnet/ndarray.py:131). XLA executes per-device streams in order,
+    so enqueueing one token computation per device and blocking on them
+    flushes all previously dispatched work."""
+    tokens = [jax.device_put(jnp.zeros(()), d) for d in jax.devices()]
+    for t in tokens:
+        t.block_until_ready()
 
 
 def moveaxis(tensor: NDArray, source: int, destination: int) -> NDArray:
@@ -447,17 +479,20 @@ def save(fname: str, data) -> None:
     for i, arr in enumerate(arrays):
         key = keys[i] if keys is not None else "__arr_%d__" % i
         payload[key] = np.asarray(arr.asnumpy())
+    # fixed-width unicode manifest: loadable with allow_pickle=False so an
+    # untrusted checkpoint can never execute code (the reference's binary
+    # NDArray format is likewise pickle-free)
     manifest = np.array(
         ["dict" if keys is not None else "list"] + [k for k in payload.keys()],
-        dtype=object)
+        dtype=np.str_)
     with open(fname, "wb") as f:
         np.savez(f, __manifest__=manifest, **payload)
 
 
 def load(fname: str):
     """(reference: mx.nd.load)."""
-    with np.load(fname, allow_pickle=True) as zf:
-        manifest = list(zf["__manifest__"])
+    with np.load(fname, allow_pickle=False) as zf:
+        manifest = [str(x) for x in zf["__manifest__"]]
         kind, keys = manifest[0], manifest[1:]
         out = {k: array(zf[k]) for k in keys}
     if kind == "list":

@@ -294,6 +294,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return (out.astype(data.dtype), mean, var, new_mm, new_mv)
 
 
+OP_REGISTRY["BatchNorm"].num_inputs = 5  # incl. the two trailing aux states
 OP_REGISTRY["BatchNorm"].num_aux = 2
 OP_REGISTRY["BatchNorm"].num_hidden_outputs = 2  # mean,var hidden unless output_mean_var
 
@@ -415,10 +416,21 @@ def _softmax_output_bwd(akey, res, g):
     use_ignore = attrs.get("use_ignore", False)
     normalization = attrs.get("normalization", "null")
     multi_output = attrs.get("multi_output", False)
+    preserve_shape = attrs.get("preserve_shape", False)
+    orig_shape, orig_label = out.shape, label
+    if not multi_output and not preserve_shape and out.ndim > 2:
+        # default mode softmaxes over the *flattened* trailing axes
+        # (forward reshapes to (N, -1)); the p-minus-onehot formula must use
+        # the same geometry or the distribution premise breaks
+        out = out.reshape(out.shape[0], -1)
+        label = label.reshape(label.shape[0], -1) if label.ndim > 1 \
+            else label
     cls_axis = 1 if multi_output else -1
     depth = out.shape[cls_axis]
     lab = label.astype(jnp.int32)
     oh = jax.nn.one_hot(lab, depth, axis=cls_axis, dtype=out.dtype)
+    if oh.ndim > out.ndim:  # label had a trailing axis of size 1 etc.
+        oh = oh.reshape(out.shape)
     grad = out - oh
     valid = jnp.ones_like(label, dtype=out.dtype)
     if use_ignore:
@@ -428,7 +440,8 @@ def _softmax_output_bwd(akey, res, g):
         grad = grad / out.shape[0]
     elif normalization == "valid":
         grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
-    return (grad * grad_scale).astype(out.dtype), jnp.zeros_like(label)
+    grad = grad.reshape(orig_shape)
+    return (grad * grad_scale).astype(out.dtype), jnp.zeros_like(orig_label)
 
 
 _softmax_output_p.defvjp(_softmax_output_fwd, _softmax_output_bwd)
@@ -560,12 +573,48 @@ def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                                     use_linear=use_linear))
 
 
-@register("IdentityAttachKLSparseReg")
-def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
-                                  momentum=0.9):
-    """Identity with KL sparsity regularizer gradient (reference:
-    src/operator/identity_attach_KL_sparse_reg.cc). Forward identity; the
-    regularizer gradient is folded in via a custom term."""
-    # Implemented as identity + stop-grad KL penalty contribution; the exact
-    # reference semantics adjust the backward with rho-hat statistics.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _kl_sparse_p(data, moving_avg, akey):
     return data
+
+
+def _kl_sparse_fwd(data, moving_avg, akey):
+    return data, (data, moving_avg)
+
+
+def _kl_sparse_bwd(akey, res, g):
+    attrs = dict(akey)
+    data, moving_avg = res
+    rho = attrs.get("sparseness_target", 0.1)
+    penalty = attrs.get("penalty", 0.001)
+    momentum = attrs.get("momentum", 0.9)
+    flat = data.reshape(data.shape[0], -1)
+    avg = jnp.mean(flat, axis=0)
+    new_ma = momentum * moving_avg + (1 - momentum) * avg
+    grad = g.reshape(flat.shape) + penalty * (
+        -rho / new_ma + (1 - rho) / (1 - new_ma))
+    return grad.reshape(data.shape).astype(data.dtype), jnp.zeros_like(moving_avg)
+
+
+_kl_sparse_p.defvjp(_kl_sparse_fwd, _kl_sparse_bwd)
+
+
+@register("IdentityAttachKLSparseReg", num_inputs=2)
+def identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity forward; backward attaches the KL sparsity penalty gradient
+    ``penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat))`` using a momentum
+    moving average rho_hat of the per-unit mean activation (reference:
+    src/operator/identity_attach_KL_sparse_reg-inl.h Backward). Pair only
+    with sigmoid activations. Input 1 is the ``moving_avg`` aux state; the
+    updated average is returned as the trailing aux output."""
+    flat = data.reshape(data.shape[0], -1)
+    avg = jnp.mean(flat, axis=0)
+    new_ma = momentum * moving_avg + (1 - momentum) * avg
+    out = _kl_sparse_p(data, moving_avg,
+                       _attrs_key(sparseness_target=sparseness_target,
+                                  penalty=penalty, momentum=momentum))
+    return out, new_ma
+
+
+OP_REGISTRY["IdentityAttachKLSparseReg"].num_aux = 1

@@ -65,6 +65,45 @@ class OpDef:
         # aux tail) hidden from the user unless an attr exposes them.
         self.num_aux: int = 0
         self.num_hidden_outputs: int = 0
+        # Symbol-layer metadata (reference: nnvm FListInputNames — names of
+        # tensor inputs so mx.sym can auto-create weight/bias variables, e.g.
+        # "fc1_weight"). None -> derived from the fn signature / defaults.
+        self._input_names: Optional[List[str]] = None
+        self.aux_input_names: List[str] = []
+
+    @property
+    def input_names(self) -> List[str]:
+        """Names of the op's tensor inputs (excluding aux states)."""
+        if self._input_names is None:
+            import inspect
+            try:
+                params = list(inspect.signature(self.fn).parameters.values())
+            except (TypeError, ValueError):
+                params = []
+            names: List[str] = []
+            # num_inputs counts ALL tensor inputs including trailing aux
+            n = self.num_inputs
+            for p in params:
+                if p.kind in (p.VAR_POSITIONAL,):
+                    names.append("data")
+                    break
+                if p.kind not in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                    break
+                if n is not None and len(names) >= n:
+                    break
+                if p.default is inspect.Parameter.empty or p.name in (
+                        "weight", "bias", "gamma", "beta", "label",
+                        "moving_mean", "moving_var", "moving_avg"):
+                    names.append(p.name)
+                else:
+                    break
+            if not names:
+                names = ["data"]
+            if self.num_aux:
+                self.aux_input_names = names[-self.num_aux:]
+                names = names[: len(names) - self.num_aux]
+            self._input_names = names
+        return self._input_names
 
     def __call__(self, *args, **kwargs):
         return self.fn(*args, **kwargs)

@@ -1,0 +1,268 @@
+"""Testing oracles.
+
+Reference: ``python/mxnet/test_utils.py`` (SURVEY.md §4) — numpy is the
+reference implementation, ``check_numeric_gradient`` (test_utils.py:439)
+validates every backward against central finite differences, and
+``check_consistency`` (test_utils.py:784) cross-checks contexts/dtypes.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from . import ndarray as nd
+from . import autograd
+
+__all__ = [
+    "default_context", "set_default_context", "assert_almost_equal",
+    "almost_equal", "same", "rand_ndarray", "random_arrays",
+    "check_numeric_gradient", "check_symbolic_forward",
+    "check_symbolic_backward", "check_consistency", "numeric_grad",
+]
+
+_default_ctx: Optional[Context] = None
+
+
+def default_context() -> Context:
+    """(reference: test_utils.py:47 — swappable via env so the same suite
+    runs on CPU interpreter or a real TPU chip)."""
+    if _default_ctx is not None:
+        return _default_ctx
+    env = os.environ.get("MXNET_TEST_DEFAULT_CTX")
+    if env:
+        kind, _, idx = env.partition(":")
+        return Context(kind, int(idx or 0))
+    return current_context()
+
+
+def set_default_context(ctx: Context) -> None:
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def same(a: np.ndarray, b: np.ndarray) -> bool:
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8) -> bool:
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def _to_np(x):
+    if isinstance(x, nd.NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")) -> None:
+    """(reference: test_utils.py:148)."""
+    a, b = _to_np(a), _to_np(b)
+    if a.shape != b.shape:
+        raise AssertionError(
+            "shape mismatch: %s=%s vs %s=%s" % (names[0], a.shape, names[1], b.shape))
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        err = np.abs(a - b)
+        rel = err / (np.abs(b) + atol)
+        idx = np.unravel_index(np.argmax(rel), rel.shape)
+        raise AssertionError(
+            "%s and %s differ: max rel err %g at %s (%g vs %g), "
+            "max abs err %g" % (names[0], names[1], float(rel.max()), idx,
+                                a[idx], b[idx], float(err.max())))
+
+
+def random_arrays(*shapes, dtype=np.float32) -> List[np.ndarray]:
+    """(reference: test_utils.py random_arrays)."""
+    arrays = [np.random.randn(*s).astype(dtype) if s else
+              np.array(np.random.randn(), dtype=dtype) for s in shapes]
+    return arrays
+
+
+def rand_ndarray(shape, ctx=None, dtype=np.float32) -> nd.NDArray:
+    return nd.array(np.random.randn(*shape).astype(dtype), ctx=ctx)
+
+
+# ------------------------------------------------------------------ gradient
+
+
+def numeric_grad(f: Callable[[Mapping[str, np.ndarray]], float],
+                 location: Dict[str, np.ndarray],
+                 wrt: Sequence[str],
+                 eps: float = 1e-4) -> Dict[str, np.ndarray]:
+    """Central-difference gradient of a scalar function of named numpy arrays
+    (the inner loop of reference test_utils.py:439 check_numeric_gradient)."""
+    grads = {}
+    for name in wrt:
+        base = location[name]
+        g = np.zeros_like(base, dtype=np.float64)
+        flat = base.reshape(-1)
+        gflat = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = f(location)
+            flat[i] = orig - eps
+            fm = f(location)
+            flat[i] = orig
+            gflat[i] = (fp - fm) / (2 * eps)
+        grads[name] = g.reshape(base.shape)
+    return grads
+
+
+def check_numeric_gradient(fn: Union[Callable, "object"],
+                           location: Union[Dict[str, np.ndarray], Sequence[np.ndarray]],
+                           aux_states: Optional[Dict[str, np.ndarray]] = None,
+                           numeric_eps: float = 1e-3,
+                           rtol: float = 2e-2,
+                           atol: float = 2e-3,
+                           grad_nodes: Optional[Sequence[str]] = None,
+                           ctx: Optional[Context] = None) -> None:
+    """Finite-difference-check the autograd backward of ``fn``.
+
+    ``fn`` is either a callable taking NDArrays (keyword by name for dict
+    locations, positional for list locations) and returning one NDArray, or a
+    Symbol (reference: test_utils.py:439 takes a Symbol; the callable form is
+    the imperative-first equivalent). The output is reduced with a fixed
+    random projection so the head gradient exercise is non-trivial.
+    """
+    if hasattr(fn, "list_arguments"):  # Symbol
+        sym = fn
+        args = sym.list_arguments()
+        if isinstance(location, (list, tuple)):
+            location = dict(zip(args, location))
+        fwd = _symbol_forward_fn(sym, aux_states, ctx)
+        return check_numeric_gradient(fwd, location, None, numeric_eps, rtol,
+                                      atol, grad_nodes, ctx)
+
+    if isinstance(location, (list, tuple)):
+        location = {"arg%d" % i: v for i, v in enumerate(location)}
+        positional = True
+    else:
+        positional = False
+    location = {k: np.asarray(v, dtype=np.float64).astype(np.float32)
+                for k, v in location.items()}
+    names = list(location.keys())
+    wrt = list(grad_nodes) if grad_nodes is not None else names
+
+    proj = None  # fixed random projection, created at first forward
+
+    def run_fwd(vals: Mapping[str, np.ndarray]):
+        nonlocal proj
+        nds = {k: nd.array(v.astype(np.float32), ctx=ctx) for k, v in vals.items()}
+        out = fn(*nds.values()) if positional else fn(**nds)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        if proj is None:
+            rng = np.random.RandomState(802)
+            proj = rng.uniform(0.5, 1.5, size=out.shape).astype(np.float32)
+        return nds, out
+
+    def scalar_f(vals: Mapping[str, np.ndarray]) -> float:
+        _, out = run_fwd(vals)
+        return float(np.sum(out.asnumpy().astype(np.float64) * proj))
+
+    # symbolic gradient via autograd
+    nds = {k: nd.array(v.astype(np.float32), ctx=ctx) for k, v in location.items()}
+    for k in wrt:
+        nds[k].attach_grad()
+    with autograd.record():
+        out = fn(*nds.values()) if positional else fn(**nds)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+    if proj is None:
+        rng = np.random.RandomState(802)
+        proj = rng.uniform(0.5, 1.5, size=out.shape).astype(np.float32)
+    out.backward(out_grad=nd.array(proj))
+    sym_grads = {k: nds[k].grad.asnumpy() for k in wrt}
+
+    num_grads = numeric_grad(scalar_f, location, wrt, eps=numeric_eps)
+    for k in wrt:
+        assert_almost_equal(sym_grads[k], num_grads[k].astype(np.float32),
+                            rtol=rtol, atol=atol,
+                            names=("autograd[%s]" % k, "numeric[%s]" % k))
+
+
+def _symbol_forward_fn(sym, aux_states, ctx):
+    """Adapt a Symbol into a callable for check_numeric_gradient."""
+
+    def fwd(**kwargs):
+        outs = sym.eval(ctx=ctx, aux_states=aux_states, **kwargs)
+        return outs[0] if isinstance(outs, (list, tuple)) else outs
+
+    return fwd
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, ctx=None) -> None:
+    """Forward outputs vs numpy expectation (reference: test_utils.py:552)."""
+    outs = _eval_fn_or_sym(sym, location, aux_states, ctx)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol,
+                            names=("output[%d]" % i, "expected[%d]" % i))
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, grad_nodes=None, ctx=None) -> None:
+    """Backward grads vs numpy expectation (reference: test_utils.py:617)."""
+    if hasattr(sym, "list_arguments"):
+        fn = _symbol_forward_fn(sym, None, ctx)
+        names = sym.list_arguments()
+        if isinstance(location, (list, tuple)):
+            location = dict(zip(names, location))
+    else:
+        fn = sym
+        names = list(location.keys())
+    nds = {k: nd.array(v, ctx=ctx) for k, v in location.items()}
+    wrt = list(grad_nodes) if grad_nodes is not None else list(nds)
+    for k in wrt:
+        nds[k].attach_grad()
+    with autograd.record():
+        out = fn(**nds)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+    og = out_grads[0] if isinstance(out_grads, (list, tuple)) else out_grads
+    out.backward(out_grad=nd.array(og))
+    if isinstance(expected, dict):
+        items = expected.items()
+    else:
+        items = zip(wrt, expected)
+    for k, e in items:
+        assert_almost_equal(nds[k].grad, e, rtol=rtol, atol=atol,
+                            names=("grad[%s]" % k, "expected[%s]" % k))
+
+
+def _eval_fn_or_sym(sym, location, aux_states, ctx):
+    if hasattr(sym, "list_arguments"):
+        names = sym.list_arguments()
+        if isinstance(location, (list, tuple)):
+            location = dict(zip(names, location))
+        return sym.eval(ctx=ctx, aux_states=aux_states,
+                        **{k: nd.array(v, ctx=ctx) for k, v in location.items()})
+    nds = {k: nd.array(v, ctx=ctx) for k, v in location.items()} \
+        if isinstance(location, dict) else [nd.array(v, ctx=ctx) for v in location]
+    return sym(**nds) if isinstance(nds, dict) else sym(*nds)
+
+
+def check_consistency(fn, locations, ctx_list=None, rtol=1e-3, atol=1e-5):
+    """Run the same computation across contexts/dtypes and cross-compare
+    (reference: test_utils.py:784 — cpu-fp32 vs gpu-fp16 etc.; here
+    CPU interpreter vs accelerator and fp32 vs bf16)."""
+    from .context import tpu, num_devices
+    if ctx_list is None:
+        ctx_list = [cpu(0)]
+        if num_devices("tpu"):
+            ctx_list.append(tpu(0))
+    outs = []
+    for ctx in ctx_list:
+        nds = {k: nd.array(v, ctx=ctx) for k, v in locations.items()}
+        o = fn(**nds)
+        if isinstance(o, (list, tuple)):
+            o = o[0]
+        outs.append(o.asnumpy())
+    for o in outs[1:]:
+        assert_almost_equal(outs[0], o, rtol=rtol, atol=atol)
+    return outs
