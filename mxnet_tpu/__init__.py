@@ -15,15 +15,21 @@ Usage mirrors the reference::
 """
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_devices
+from .name import NameManager, AttrScope
 from . import ops
 from . import ndarray
 from . import ndarray as nd
 from . import random
 from . import autograd
+from . import symbol
+from . import symbol as sym
+from . import executor
+from . import test_utils
 
 __version__ = "0.1.0"
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
-    "num_devices", "nd", "ndarray", "random", "autograd",
+    "num_devices", "nd", "ndarray", "random", "autograd", "sym", "symbol",
+    "executor", "NameManager", "AttrScope", "test_utils",
 ]

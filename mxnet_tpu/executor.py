@@ -1,0 +1,375 @@
+"""Graph executor.
+
+Reference: ``src/executor/graph_executor.cc`` + ``include/mxnet/executor.h``
+(SURVEY.md §2.6): the reference binds a symbol into per-node engine ops with a
+memory plan; Forward/Backward push cached ops in topo order.
+
+TPU design: the whole bound graph is ONE jitted XLA program (SURVEY.md §7 —
+the dependency engine, PlanMemory pass and bulk-exec segments all collapse
+into XLA compilation/buffer assignment). Three compiled entry points per
+executor:
+
+* forward (inference): jitted graph function.
+* forward+backward (training): one jitted program computing outputs AND all
+  requested input gradients via ``jax.vjp`` — ``Executor.forward(is_train=
+  True)`` defers computation so ``backward()`` runs the fused program once
+  (no duplicated forward FLOPs in the fit loop).
+* aux states (BatchNorm moving stats) are returned functionally and committed
+  after each step (the reference mutates them in-place during Forward).
+
+Model parallelism (`group2ctx`, reference graph_executor.cc:279-393
+AssignContext + PlaceDevice + _CrossDeviceCopy): expressed as per-argument
+``SingleDeviceSharding`` in ``jit(in_shardings=...)`` — XLA inserts the
+cross-device transfers the reference inserted as copy nodes.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context
+from . import ndarray as _nd
+from . import random as _random
+
+__all__ = ["Executor", "graph_function"]
+
+
+def _accepts_is_train(op) -> bool:
+    cached = getattr(op, "_accepts_is_train", None)
+    if cached is None:
+        try:
+            cached = "_is_train" in inspect.signature(op.fn).parameters
+        except (TypeError, ValueError):
+            cached = False
+        op._accepts_is_train = cached
+    return cached
+
+
+def graph_function(symbol):
+    """Compile a Symbol into a pure function
+    ``fn(args_dict, aux_dict, rng_key, is_train) -> (outputs, new_aux_dict)``.
+
+    The TPU analogue of GraphExecutor::InitCachedOps + RunOps
+    (graph_executor.cc:1013-1231): instead of one engine op per node, the
+    topo-ordered node list becomes one traced JAX program for XLA to fuse
+    and schedule.
+    """
+    from .symbol.symbol import _topo_order
+
+    nodes = _topo_order(symbol._entries)
+    entries = list(symbol._entries)
+
+    def fn(args: Dict[str, Any], aux: Dict[str, Any], key, is_train: bool):
+        vals: Dict[Any, Any] = {}
+        new_aux: Dict[str, Any] = {}
+        for idx, node in enumerate(nodes):
+            if node.is_variable:
+                if node.name in args:
+                    v = args[node.name]
+                elif node.name in aux:
+                    v = aux[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+                vals[(id(node), 0)] = v
+                continue
+            ins = [vals[(id(n), i)] for n, i in node.inputs]
+            attrs = dict(node.attrs)
+            attrs.pop("name", None)
+            if _accepts_is_train(node.op):
+                attrs["_is_train"] = is_train
+            if node.op.needs_rng:
+                attrs["_rng"] = jax.random.fold_in(key, idx)
+            outs = node.op.fn(*ins, **attrs)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+            n_aux = node.op.num_aux
+            if n_aux:
+                for (src, _), val in zip(node.inputs[-n_aux:], outs[-n_aux:]):
+                    if src.is_variable:
+                        new_aux[src.name] = val
+        outputs = [vals[(id(n), i)] for n, i in entries]
+        return outputs, new_aux
+
+    return fn
+
+
+def _normalize_dict(values, names, what):
+    if values is None:
+        return None
+    if isinstance(values, dict):
+        return dict(values)
+    if isinstance(values, (list, tuple)):
+        if len(values) != len(names):
+            raise MXNetError("%s: expected %d entries, got %d"
+                             % (what, len(names), len(values)))
+        return dict(zip(names, values))
+    raise MXNetError("%s must be list or dict" % what)
+
+
+class Executor:
+    """Bound computation (reference: include/mxnet/executor.h:52-152)."""
+
+    def __init__(self, symbol, ctx: Context, args, args_grad=None,
+                 grad_req="write", aux_states=None, group2ctx=None,
+                 shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self.arg_dict: Dict[str, _nd.NDArray] = \
+            _normalize_dict(args, self._arg_names, "args") or {}
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        self.aux_dict: Dict[str, _nd.NDArray] = \
+            _normalize_dict(aux_states, self._aux_names, "aux_states") or {}
+        missing = [n for n in self._aux_names if n not in self.aux_dict]
+        if missing:
+            raise MXNetError("bind: missing auxiliary states %s" % missing)
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self._arg_names}
+        self.grad_dict: Dict[str, _nd.NDArray] = \
+            _normalize_dict(args_grad, self._arg_names, "args_grad") or {}
+        self._wrt = [n for n in self._arg_names
+                     if self._grad_req.get(n, "null") != "null"
+                     and n in self.grad_dict]
+
+        self._group2ctx = group2ctx
+        self._shared_exec = shared_exec
+        self._fn = graph_function(symbol)
+        self._base_key = _random.next_key()
+        self._step = 0
+        self._outputs: Optional[List[_nd.NDArray]] = None
+        self._pending = None   # (arg_vals, aux_vals, key) awaiting fused bwd
+        self._monitor_callback = None
+
+        in_shardings = self._arg_shardings()
+        jit_kwargs = {"static_argnums": (3,)}
+        if in_shardings is not None:
+            jit_kwargs["in_shardings"] = (in_shardings[0], in_shardings[1],
+                                          None)
+        self._jit_fwd = jax.jit(self._fn, **jit_kwargs)
+
+        def fwd_bwd(arg_vals, aux_vals, key, head_grads):
+            diff = {n: arg_vals[n] for n in self._wrt}
+            rest = {n: v for n, v in arg_vals.items() if n not in diff}
+
+            def f(d):
+                outs, new_aux = self._fn({**rest, **d}, aux_vals, key, True)
+                return outs, new_aux
+
+            (outs, new_aux), vjp = jax.vjp(f, diff, has_aux=False)
+            cts = [g if g is not None else jnp.ones_like(o)
+                   for g, o in zip(head_grads, outs)]
+            grads = vjp((cts, {k: jnp.zeros_like(v)
+                               for k, v in new_aux.items()}))[0]
+            return outs, new_aux, grads
+
+        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+
+    # ------------------------------------------------------------ shardings
+    def _arg_shardings(self):
+        """group2ctx → per-argument SingleDeviceSharding (the PlaceDevice
+        pass, reference graph_executor.cc:279-393)."""
+        if not self._group2ctx:
+            return None
+        from .symbol.symbol import _topo_order
+        from jax.sharding import SingleDeviceSharding
+
+        group_of: Dict[str, str] = {}
+        for node in _topo_order(self._symbol._entries):
+            g = node.str_attrs.get("ctx_group")
+            if not g:
+                continue
+            if node.is_variable:
+                group_of.setdefault(node.name, g)
+            else:
+                for src, _ in node.inputs:
+                    if src.is_variable:
+                        group_of.setdefault(src.name, g)
+
+        def dev_for(name):
+            g = group_of.get(name)
+            ctx = self._group2ctx.get(g, self._ctx) if g else self._ctx
+            return SingleDeviceSharding(ctx.jax_device)
+
+        arg_sh = {n: dev_for(n) for n in self._arg_names}
+        aux_sh = {n: dev_for(n) for n in self._aux_names}
+        return arg_sh, aux_sh
+
+    # ------------------------------------------------------------ running
+    def _gather(self):
+        arg_vals = {n: a.data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a.data for n, a in self.aux_dict.items()}
+        self._step += 1
+        key = jax.random.fold_in(self._base_key, self._step)
+        return arg_vals, aux_vals, key
+
+    def forward(self, is_train: bool = False, **kwargs) -> List[_nd.NDArray]:
+        """(reference: GraphExecutor::Forward, graph_executor.cc:50). With
+        ``is_train=True`` the computation is deferred so ``backward`` can run
+        the fused forward+backward program once."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % k)
+            self.arg_dict[k]._data = v.data if isinstance(v, _nd.NDArray) \
+                else jnp.asarray(v)
+            self.arg_dict[k]._version += 1
+        arg_vals, aux_vals, key = self._gather()
+        if is_train and self._wrt:
+            self._pending = (arg_vals, aux_vals, key)
+            self._outputs = None
+        else:
+            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
+                                          bool(is_train))
+            self._commit(outs, new_aux)
+            self._pending = None
+        if self._monitor_callback:
+            self._run_monitor()
+        return self.outputs
+
+    def backward(self, out_grads=None) -> None:
+        """(reference: GraphExecutor::Backward, graph_executor.cc:63).
+        Runs the fused forward+backward program; gradients are committed to
+        ``grad_dict`` honoring grad_req write/add (kAddTo semantics,
+        include/mxnet/op_attr_types.h:45-58)."""
+        if self._pending is None:
+            raise MXNetError("backward called without forward(is_train=True)")
+        arg_vals, aux_vals, key = self._pending
+        if out_grads is None:
+            heads = [None] * len(self._output_names)
+        elif isinstance(out_grads, (list, tuple)):
+            heads = [g.data if isinstance(g, _nd.NDArray) else jnp.asarray(g)
+                     for g in out_grads]
+        else:
+            heads = [out_grads.data if isinstance(out_grads, _nd.NDArray)
+                     else jnp.asarray(out_grads)]
+        outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals, key,
+                                                 heads)
+        self._commit(outs, new_aux)
+        self._pending = None
+        for n, g in grads.items():
+            req = self._grad_req.get(n, "null")
+            buf = self.grad_dict.get(n)
+            if buf is None or req == "null":
+                continue
+            if req == "add":
+                buf._data = buf.data + g.astype(buf.dtype)
+            else:
+                buf._data = g.astype(buf.dtype)
+            buf._version += 1
+
+    def _commit(self, outs, new_aux):
+        self._outputs = [_nd.NDArray(o) for o in outs]
+        for n, v in new_aux.items():
+            a = self.aux_dict[n]
+            a._data = v
+            a._version += 1
+
+    @property
+    def outputs(self) -> List[_nd.NDArray]:
+        """(reference: executor.h outputs). Computes lazily if a deferred
+        training forward is pending."""
+        if self._outputs is None and self._pending is not None:
+            arg_vals, aux_vals, key = self._pending
+            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key, True)
+            self._commit(outs, new_aux)
+        if self._outputs is None:
+            raise MXNetError("no forward has been run")
+        return self._outputs
+
+    @property
+    def arg_arrays(self) -> List[_nd.NDArray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self) -> List[Optional[_nd.NDArray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self) -> List[_nd.NDArray]:
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self) -> Dict[str, _nd.NDArray]:
+        return dict(zip(self._output_names, self.outputs))
+
+    def copy_params_from(self, arg_params: Dict[str, _nd.NDArray],
+                         aux_params: Optional[Dict[str, _nd.NDArray]] = None,
+                         allow_extra_params: bool = False) -> None:
+        """(reference: executor.py copy_params_from)."""
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                v.copyto(self.arg_dict[k])
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %r" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    v.copyto(self.aux_dict[k])
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound to new shapes (reference: executor.py
+        reshape). jit re-specializes per shape automatically; parameters are
+        shared by reference."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for n, s in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if tuple(cur.shape) == tuple(s):
+                new_args[n] = cur
+            else:
+                new_args[n] = _nd.NDArray(np.zeros(s, dtype=cur.dtype),
+                                          ctx=self._ctx)
+        new_grads = None
+        if self.grad_dict:
+            new_grads = {}
+            for n in self.grad_dict:
+                s = arg_shapes[self._arg_names.index(n)]
+                cur = self.grad_dict[n]
+                new_grads[n] = cur if tuple(cur.shape) == tuple(s) else \
+                    _nd.NDArray(np.zeros(s, dtype=cur.dtype), ctx=self._ctx)
+        new_aux = {}
+        for n, s in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            new_aux[n] = cur if tuple(cur.shape) == tuple(s) else \
+                _nd.NDArray(np.zeros(s, dtype=cur.dtype), ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux, group2ctx=self._group2ctx,
+                        shared_exec=self)
+
+    # ------------------------------------------------------------ monitor
+    def set_monitor_callback(self, callback) -> None:
+        """(reference: MXExecutorSetMonitorCallback / Monitor support —
+        graph_executor.cc:1209 ExecuteMonCallback). Called as
+        callback(name, NDArray) for every output after each forward."""
+        self._monitor_callback = callback
+
+    def _run_monitor(self):
+        for name, arr in zip(self._output_names, self.outputs):
+            self._monitor_callback(name, arr)
+
+    def debug_str(self) -> str:
+        from .symbol.symbol import _topo_order
+        lines = ["Symbol outputs: %s" % ", ".join(self._output_names)]
+        for node in _topo_order(self._symbol._entries):
+            kind = "var" if node.is_variable else node.op.name
+            lines.append("  %-20s %s" % (kind, node.name))
+        return "\n".join(lines)

@@ -1,0 +1,68 @@
+"""mx.sym — symbolic API.
+
+Wrappers are auto-generated from the op registry, exactly like the
+reference's ``_init_symbol_module`` (python/mxnet/symbol.py tail) generates
+them from the C op registry.
+"""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     make_symbol_function, _create)
+from ..ops import OP_REGISTRY, get_op
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+def _init_symbol_module():
+    seen = {}
+    for name, op in OP_REGISTRY.items():
+        if name.startswith("_Function"):
+            continue
+        if id(op) not in seen:
+            seen[id(op)] = make_symbol_function(op)
+        fn = seen[id(op)]
+        globals()[name] = fn
+        if name not in __all__:
+            __all__.append(name)
+
+
+def _attach_symbol_methods():
+    """Common ops as Symbol methods (reference: generated Symbol methods)."""
+    names = [
+        "sum", "mean", "max", "min", "prod", "argmax", "argmin", "clip",
+        "abs", "sign", "round", "floor", "ceil", "sqrt", "square", "exp",
+        "log", "sigmoid", "tanh", "relu", "softmax", "log_softmax",
+        "transpose", "swapaxes", "flatten", "expand_dims", "repeat", "tile",
+        "flip", "sort", "argsort", "topk", "take", "one_hot",
+        "broadcast_to", "slice_axis", "squeeze", "norm", "split", "slice",
+        "reshape", "dot", "astype",
+    ]
+    for nm in names:
+        if nm not in OP_REGISTRY or hasattr(Symbol, nm):
+            continue
+
+        def make(nm):
+            def method(self, *args, **kwargs):
+                op = get_op(nm)
+                syms = [self] + [a for a in args if isinstance(a, Symbol)]
+                attrs = {k: v for k, v in kwargs.items()
+                         if not isinstance(v, Symbol)}
+                pos_attrs = [a for a in args if not isinstance(a, Symbol)]
+                if pos_attrs:
+                    # positional non-symbol args (e.g. reshape(shape))
+                    import inspect as _i
+                    try:
+                        params = [p for p in
+                                  _i.signature(op.fn).parameters.values()][1:]
+                        for p, v in zip(params, pos_attrs):
+                            attrs[p.name] = v
+                    except (TypeError, ValueError):
+                        pass
+                name = attrs.pop("name", None)
+                return _create(op, syms, attrs, name)
+            method.__name__ = nm
+            return method
+
+        setattr(Symbol, nm, make(nm))
+
+
+_init_symbol_module()
+_attach_symbol_methods()

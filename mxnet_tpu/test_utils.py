@@ -185,11 +185,35 @@ def check_numeric_gradient(fn: Union[Callable, "object"],
 
 
 def _symbol_forward_fn(sym, aux_states, ctx):
-    """Adapt a Symbol into a callable for check_numeric_gradient."""
+    """Adapt a Symbol into an *imperative* callable so the evaluation is
+    recorded on the autograd tape (the reference equivalently binds and runs
+    the executor backward; here nd-level replay is the backward engine)."""
+    from .symbol.symbol import _topo_order
+    from .ndarray import imperative_invoke
+
+    nodes = _topo_order(sym._entries)
 
     def fwd(**kwargs):
-        outs = sym.eval(ctx=ctx, aux_states=aux_states, **kwargs)
-        return outs[0] if isinstance(outs, (list, tuple)) else outs
+        vals = {}
+        for node in nodes:
+            if node.is_variable:
+                if node.name in kwargs:
+                    v = kwargs[node.name]
+                elif aux_states and node.name in aux_states:
+                    a = aux_states[node.name]
+                    v = a if isinstance(a, nd.NDArray) else nd.array(a)
+                else:
+                    raise ValueError("missing input %r" % node.name)
+                vals[(id(node), 0)] = v
+                continue
+            ins = [vals[(id(n), i)] for n, i in node.inputs]
+            attrs = {k: v for k, v in node.attrs.items() if k != "name"}
+            out = imperative_invoke(node.op, *ins, **attrs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+        results = [vals[(id(n), i)] for n, i in sym._entries]
+        return results[0] if len(results) == 1 else results
 
     return fwd
 

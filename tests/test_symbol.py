@@ -1,0 +1,189 @@
+"""Symbol + Executor tests (reference test model: tests/python/unittest/
+test_symbol.py, test_executor.py, test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_list_arguments_order():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape_mlp():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(4, 8), softmax_label=(4,))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 8)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert out_shapes == [(4, 10)]
+
+
+def test_infer_shape_conv_bn():
+    d = mx.sym.Variable("data")
+    c = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           stride=(2, 2), name="c1")
+    b = mx.sym.BatchNorm(c, name="bn1")
+    f = mx.sym.FullyConnected(b, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, aux_shapes = f.infer_shape(data=(2, 3, 8, 8))
+    args = dict(zip(f.list_arguments(), arg_shapes))
+    assert args["c1_weight"] == (8, 3, 3, 3)
+    assert args["bn1_gamma"] == (8,)
+    assert dict(zip(f.list_auxiliary_states(), aux_shapes))[
+        "bn1_moving_mean"] == (8,)
+    assert out_shapes == [(2, 10)]
+
+
+def test_json_round_trip():
+    out = _mlp()
+    out2 = mx.sym.load_json(out.tojson())
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    a1, o1, _ = out.infer_shape(data=(4, 8), softmax_label=(4,))
+    a2, o2, _ = out2.infer_shape(data=(4, 8), softmax_label=(4,))
+    assert a1 == a2 and o1 == o2
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a + b) * 2.0 - a / 2.0
+    outs = c.eval(a=mx.nd.array([2.0]), b=mx.nd.array([3.0]))
+    assert_almost_equal(outs[0], np.array([9.0]))
+
+
+def test_symbol_compose():
+    a = mx.sym.Variable("a")
+    net = mx.sym.FullyConnected(a, num_hidden=4, name="fc")
+    data2 = mx.sym.Variable("d2")
+    net2 = net(a=data2)
+    assert "d2" in net2.list_arguments()
+    assert "a" not in net2.list_arguments()
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    feat = internals["fc1_output"]
+    assert feat.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_executor_forward_matches_numpy():
+    np.random.seed(0)
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(0), data=(4, 8), softmax_label=(4,))
+    params = {n: np.random.randn(*a.shape).astype(np.float32) * 0.1
+              for n, a in ex.arg_dict.items() if n.endswith(("weight", "bias"))}
+    for n, v in params.items():
+        ex.arg_dict[n][:] = mx.nd.array(v)
+    x = np.random.randn(4, 8).astype(np.float32)
+    ex.forward(is_train=False, data=mx.nd.array(x),
+               softmax_label=mx.nd.array([0, 1, 2, 3]))
+    h = np.maximum(x.dot(params["fc1_weight"].T) + params["fc1_bias"], 0)
+    logits = h.dot(params["fc2_weight"].T) + params["fc2_bias"]
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    assert_almost_equal(ex.outputs[0], p, rtol=1e-4, atol=1e-5)
+
+
+def test_executor_backward_softmax_ce():
+    np.random.seed(1)
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(0), data=(4, 8), softmax_label=(4,))
+    for n, a in ex.arg_dict.items():
+        if n.endswith(("weight", "bias")):
+            a[:] = mx.nd.array(np.random.randn(*a.shape).astype(np.float32) * 0.1)
+    label = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex.forward(is_train=True, data=mx.nd.array(np.random.randn(4, 8)),
+               softmax_label=mx.nd.array(label))
+    ex.backward()
+    p = ex.outputs[0].asnumpy()
+    # data-grad of fc2 output head = p - onehot; check via fc2_bias grad
+    oh = np.zeros_like(p)
+    oh[np.arange(4), label.astype(int)] = 1
+    assert_almost_equal(ex.grad_dict["fc2_bias"], (p - oh).sum(0),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_executor_grad_req_add_and_null():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    loss = mx.sym.MakeLoss(out.sum())
+    ex = loss.simple_bind(mx.cpu(0), data=(2, 4),
+                          grad_req={"data": "null", "fc_weight": "add",
+                                    "fc_bias": "write"})
+    ex.arg_dict["fc_weight"][:] = 1.0
+    x = mx.nd.array(np.ones((2, 4), np.float32))
+    for _ in range(2):
+        ex.forward(is_train=True, data=x)
+        ex.backward()
+    # weight grad accumulated twice: d(sum(xW^T+b))/dW = sum over batch of x
+    assert_almost_equal(ex.grad_dict["fc_weight"],
+                        np.full((3, 4), 4.0), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(ex.grad_dict["fc_bias"], np.full((3,), 2.0),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_executor_aux_update():
+    d = mx.sym.Variable("data")
+    b = mx.sym.BatchNorm(d, name="bn", momentum=0.5, fix_gamma=False)
+    loss = mx.sym.MakeLoss(b)
+    ex = loss.simple_bind(mx.cpu(0), data=(8, 3))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.randn(8, 3).astype(np.float32) + 2.0
+    ex.forward(is_train=True, data=mx.nd.array(x))
+    ex.backward()
+    expected_mm = 0.5 * 0.0 + 0.5 * x.mean(0)
+    assert_almost_equal(ex.aux_dict["bn_moving_mean"], expected_mm,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_symbol_numeric_gradient():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    out = mx.sym.FullyConnected(data, w, num_hidden=3, no_bias=True,
+                                name="fc")
+    out = mx.sym.Activation(out, act_type="tanh")
+    check_numeric_gradient(out, {"data": np.random.randn(2, 4),
+                                 "w": np.random.randn(3, 4)})
+
+
+def test_group_and_multi_output():
+    a = mx.sym.Variable("a")
+    g = mx.sym.Group([a * 2.0, a + 1.0])
+    assert len(g.list_outputs()) == 2
+    outs = g.eval(a=mx.nd.array([1.0, 2.0]))
+    assert_almost_equal(outs[0], np.array([2.0, 4.0]))
+    assert_almost_equal(outs[1], np.array([2.0, 3.0]))
+
+
+def test_attr_scope_ctx_group():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        b = a * 2.0
+    assert a.attr("ctx_group") == "dev1"
+
+
+def test_variable_shape_attr():
+    data = mx.sym.Variable("data", shape=(4, 8))
+    fc = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape()
+    assert out_shapes == [(4, 2)]
