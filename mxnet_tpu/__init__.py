@@ -25,6 +25,15 @@ from . import symbol
 from . import symbol as sym
 from . import executor
 from . import test_utils
+from . import optimizer
+from . import optimizer as opt
+from . import initializer
+from . import initializer as init
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import model
+from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constant
 
 __version__ = "0.1.0"
 

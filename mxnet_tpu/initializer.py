@@ -1,0 +1,328 @@
+"""Weight initializers.
+
+Reference: ``python/mxnet/initializer.py`` (726 LoC: InitDesc:34,
+Uniform/Normal/Orthogonal, Xavier:545, MSRAPrelu, Bilinear, LSTMBias,
+FusedRNN:676, Load/Mixed, attr-driven dispatch).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Orthogonal",
+           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "One", "Zero",
+           "Constant", "Load", "Mixed", "register", "create"]
+
+_INITIALIZER_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    """(reference: initializer.py register / generic registry.py)."""
+    _INITIALIZER_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs) -> "Initializer":
+    if isinstance(name, Initializer):
+        return name
+    return _INITIALIZER_REGISTRY[name.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers (reference:
+    initializer.py:34 — carries __init__ attr and global_init)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer(object):
+    """Base initializer with name-pattern dispatch (reference:
+    initializer.py Initializer.__call__: weight/bias/gamma/beta/
+    moving_mean/moving_var/moving_avg special-casing)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        """(reference: initializer.py dumps — JSON [name, kwargs])."""
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr: NDArray):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        if desc.attrs.get("__init__"):
+            klass, kwargs = json.loads(desc.attrs["__init__"])
+            create(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("upsampling"):
+            self._init_bilinear(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # ------------------------------------------------------- specializations
+    def _init_bilinear(self, name, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = nd.array(weight.reshape(shape))
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization "
+            "is now limited to weight/bias/gamma/beta. Use "
+            "mx.sym.Variable(init=...) to set per-variable initializers." % name)
+
+
+@register
+class Load(object):
+    """Init from an existing param dict, falling back to default_init
+    (reference: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            param = nd.load(param)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith(("arg:", "aux:")):
+                self.param[name[4:]] = arr
+            else:
+                self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if arr.shape != self.param[name].shape:
+                raise ValueError("Parameter %s shape mismatch: %s vs %s"
+                                 % (name, arr.shape, self.param[name].shape))
+            arr[:] = self.param[name]
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise ValueError("Cannot Initialize %s. Not found in loaded "
+                                 "param and no default initializer" % name)
+            self.default_init(name, arr)
+
+
+@register
+class Mixed(object):
+    """Regex-pattern dispatch over sub-initializers (reference:
+    initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must have same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError("Parameter %s did not match any pattern. Consider "
+                         "adding a \".*\" pattern at the end." % name)
+
+
+@register
+class Zero(Initializer):
+    def __call__(self, desc, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def __call__(self, desc, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def __call__(self, desc, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference: initializer.py Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = nd.array(np.random.uniform(-self.scale, self.scale,
+                                            arr.shape).astype(np.float32))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (reference: initializer.py Normal)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = nd.array(np.random.normal(0, self.sigma,
+                                           arr.shape).astype(np.float32))
+
+
+@register
+class Orthogonal(Initializer):
+    """(reference: initializer.py Orthogonal — SVD of a gaussian)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        res = u if u.shape == tmp.shape else v
+        arr[:] = nd.array(self.scale * res.reshape(arr.shape).astype(np.float32))
+
+
+@register
+class Xavier(Initializer):
+    """(reference: initializer.py:545 Xavier — uniform/gaussian over
+    avg/in/out fans)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier initializer cannot be applied to vector "
+                             "%s. It requires at least 2D." % name)
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = nd.array(np.random.uniform(-scale, scale,
+                                                shape).astype(np.float32))
+        elif self.rnd_type == "gaussian":
+            arr[:] = nd.array(np.random.normal(0, scale,
+                                               shape).astype(np.float32))
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming init accounting for PReLU slope (reference: initializer.py
+    MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """(reference: initializer.py Bilinear — deconv upsampling kernels)."""
+
+    def _init_weight(self, name, arr):
+        Initializer._init_bilinear(self, name, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Zero bias except forget gate = forget_bias (reference:
+    initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = nd.array(b)
+
+    _init_bias = _init_weight
+
+
+# name used by Variable(init=...) serialization
+def from_json(s: str):
+    klass, kwargs = json.loads(s)
+    return create(klass, **kwargs)

@@ -1,0 +1,407 @@
+"""Evaluation metrics.
+
+Reference: ``python/mxnet/metric.py`` (1,132 LoC: registry + Accuracy:339,
+TopKAccuracy:404, F1:478, Perplexity:573, MAE/MSE/RMSE:678-795,
+CrossEntropy:854, PearsonCorrelation:923, Loss, CustomMetric:1020,
+CompositeEvalMetric:209).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as numpy_mod
+
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "PearsonCorrelation", "Loss", "Torch", "Caffe", "CustomMetric",
+           "np", "create", "register"]
+
+_METRIC_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    _METRIC_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(metric, *args, **kwargs) -> "EvalMetric":
+    """(reference: metric.py create — str name, callable, or list)."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    name = str(metric).lower()
+    aliases = {"acc": "accuracy", "ce": "crossentropy",
+               "top_k_accuracy": "topkaccuracy", "top_k_acc": "topkaccuracy"}
+    name = aliases.get(name, name)
+    if name not in _METRIC_REGISTRY:
+        raise ValueError("Metric must be either callable or in %s; got %s"
+                         % (sorted(_METRIC_REGISTRY), metric))
+    return _METRIC_REGISTRY[name](*args, **kwargs)
+
+
+def _as_np(x) -> numpy_mod.ndarray:
+    return x.asnumpy() if isinstance(x, NDArray) else numpy_mod.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=0):
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError("Shape of labels %s does not match shape of "
+                         "predictions %s" % (label_shape, pred_shape))
+
+
+class EvalMetric(object):
+    """Base metric (reference: metric.py EvalMetric)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def update_dict(self, label: Dict, pred: Dict):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+
+@register
+class CompositeEvalMetric(EvalMetric):
+    """(reference: metric.py:209)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in metrics] if metrics else []
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            names.extend(name if isinstance(name, list) else [name])
+            values.extend(value if isinstance(value, list) else [value])
+        return (names, values)
+
+
+@register
+class Accuracy(EvalMetric):
+    """(reference: metric.py:339). axis: class axis of predictions."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if pred.ndim > label.ndim:
+                pred = numpy_mod.argmax(pred, axis=self.axis)
+            pred = pred.astype(numpy_mod.int32).flatten()
+            label = label.astype(numpy_mod.int32).flatten()
+            check_label_shapes(label, pred, shape=1)
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(label)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    """(reference: metric.py:404)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.top_k = top_k
+        assert self.top_k > 1, "Use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            assert pred.ndim == 2, "Predictions should be 2 dims"
+            pred = numpy_mod.argsort(pred.astype(numpy_mod.float32), axis=1)
+            label = label.astype(numpy_mod.int32)
+            num_samples, num_classes = pred.shape
+            top_k = min(num_classes, self.top_k)
+            for j in range(top_k):
+                self.sum_metric += (
+                    pred[:, num_classes - 1 - j].flatten() == label.flatten()
+                ).sum()
+            self.num_inst += num_samples
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (reference: metric.py:478)."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = _as_np(pred)
+            label = _as_np(label).astype(numpy_mod.int32)
+            pred_label = numpy_mod.argmax(pred, axis=1)
+            check_label_shapes(label.flatten(), pred_label.flatten(), shape=1)
+            if len(numpy_mod.unique(label)) > 2:
+                raise ValueError("F1 currently only supports binary "
+                                 "classification.")
+            tp = numpy_mod.sum((pred_label == 1) & (label.flatten() == 1))
+            fp = numpy_mod.sum((pred_label == 1) & (label.flatten() == 0))
+            fn = numpy_mod.sum((pred_label == 0) & (label.flatten() == 1))
+            precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+            recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+            if precision + recall > 0:
+                f1 = 2 * precision * recall / (precision + recall)
+            else:
+                f1 = 0.0
+            self.sum_metric += f1
+            self.num_inst += 1
+
+
+@register
+class Perplexity(EvalMetric):
+    """(reference: metric.py:573)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss, num = 0.0, 0
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            assert label.size == pred.size / pred.shape[-1], \
+                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+            label = label.reshape(-1).astype(numpy_mod.int64)
+            probs = pred.reshape(-1, pred.shape[-1])[
+                numpy_mod.arange(label.size), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                probs = numpy_mod.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss -= float(numpy_mod.sum(numpy_mod.log(numpy_mod.maximum(1e-10, probs))))
+            num += label.size
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    """(reference: metric.py:678)."""
+
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += numpy_mod.abs(label - pred).mean()
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    """(reference: metric.py:717)."""
+
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += ((label - pred) ** 2.0).mean()
+            self.num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    """(reference: metric.py:756)."""
+
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += numpy_mod.sqrt(((label - pred) ** 2.0).mean())
+            self.num_inst += 1
+
+
+@register
+class CrossEntropy(EvalMetric):
+    """(reference: metric.py:854)."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            label = label.ravel()
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[numpy_mod.arange(label.shape[0]), numpy_mod.int64(label)]
+            self.sum_metric += (-numpy_mod.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    """(reference: metric.py:923)."""
+
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            check_label_shapes(label, pred, 1)
+            self.sum_metric += numpy_mod.corrcoef(pred.ravel(), label.ravel())[0, 1]
+            self.num_inst += 1
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of the raw outputs — for loss symbols (reference: metric.py Loss)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        for pred in preds:
+            pred = _as_np(pred)
+            self.sum_metric += pred.sum()
+            self.num_inst += pred.size
+
+
+@register
+class Torch(Loss):
+    """(reference: metric.py Torch — mean of outputs, legacy name)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Torch):
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Wrap ``feval(label, pred) -> float`` (reference: metric.py:1020)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                sum_metric, num_inst = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Create a CustomMetric from a numpy function (reference: metric.py np)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

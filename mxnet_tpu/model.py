@@ -1,0 +1,70 @@
+"""Model checkpoint helpers (+ legacy FeedForward surface lives in Module).
+
+Reference: ``python/mxnet/model.py`` — ``save_checkpoint:340`` /
+``load_checkpoint:370`` write ``prefix-symbol.json`` + ``prefix-%04d.params``
+with ``arg:``/``aux:`` prefixed tensor names; ``_create_kvstore:57`` decides
+``update_on_kvstore``.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray]) -> None:
+    """(reference: model.py:340)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """(reference: model.py:370). Returns (symbol, arg_params, aux_params)."""
+    from . import symbol as sym
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+def _create_kvstore(kvstore, num_device: int, arg_params):
+    """Decide kvstore + update_on_kvstore (reference: model.py:57)."""
+    from . import kvstore as kvs
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(int(nd_arr.size) for nd_arr in arg_params.values())
+                if max_size < 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+from .callback import BatchEndParam  # noqa: E402  (re-export, reference parity)

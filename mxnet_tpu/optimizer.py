@@ -1,0 +1,494 @@
+"""Optimizers.
+
+Reference: ``python/mxnet/optimizer.py`` (992 LoC — registry at line 30/331,
+SGD/DCASGD/NAG/SGLD/ccSGD/Adam/AdaGrad/RMSProp/AdaDelta/Ftrl/Adamax/Nadam/
+Test at lines 334-923, ``Updater`` at 940). The numeric updates run through
+the registered optimizer-update *ops* (mxnet_tpu/ops/optimizer_op.py ≡
+src/operator/optimizer_op.cc), so each parameter update is one fused XLA
+computation.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from .ops import get_op
+from .ndarray.ndarray import imperative_invoke
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Test",
+           "create", "get_updater", "Updater", "register"]
+
+
+class Optimizer(object):
+    """Base optimizer (reference: optimizer.py:30)."""
+
+    opt_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        """(reference: optimizer.py Optimizer.register)."""
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name: str, **kwargs) -> "Optimizer":
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[Any, int] = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def set_lr_mult(self, args_lr_mult: Dict[str, float]):
+        """(reference: optimizer.py set_lr_mult — merges symbol attr
+        __lr_mult__)."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[str, float]):
+        """(reference: optimizer.py set_wd_mult — bias/gamma/beta default to
+        wd_mult 0)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index) -> float:
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _invoke(opname, arrays, out_arrays, **attrs):
+    """Run an optimizer-update op and commit results in place."""
+    op = get_op(opname)
+    res = imperative_invoke(op, *arrays, **attrs)
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    for dst, src in zip(out_arrays, res):
+        dst._data = src.data
+        dst._version += 1
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, weight decay and multi-precision support
+    (reference: optimizer.py:334 SGD)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        momentum = None
+        weight_master = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master = weight.astype(np.float32)
+        if self.momentum != 0.0:
+            base = weight_master if weight_master is not None else weight
+            momentum = nd.zeros(base.shape, dtype=base.dtype)
+        if weight_master is not None:
+            return (momentum, weight_master)
+        return momentum
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        master = None
+        mom = state
+        if isinstance(state, tuple):
+            mom, master = state
+        w = master if master is not None else weight
+        g = grad.astype(w.dtype) if grad.dtype != w.dtype else grad
+        if self.momentum == 0.0:
+            _invoke("sgd_update", [w, g], [w], lr=lr, wd=wd, **kw)
+        else:
+            _invoke("sgd_mom_update", [w, g, mom], [w, mom], lr=lr, wd=wd,
+                    momentum=self.momentum, **kw)
+        if master is not None:
+            weight._data = w.data.astype(weight.dtype)
+            weight._version += 1
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            _invoke("sgd_update", [weight, grad], [weight], lr=lr, wd=wd, **kw)
+        else:
+            _invoke("nag_mom_update", [weight, grad, state], [weight, state],
+                    lr=lr, wd=wd, momentum=self.momentum, **kw)
+
+
+@register
+class SGLD(Optimizer):
+    """Langevin dynamics sampler (reference: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        _invoke("sgld_update", [weight, grad], [weight], lr=lr, wd=wd, **kw)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous: Dict[Any, NDArray] = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = nd.zeros(weight.shape, dtype=weight.dtype) \
+            if self.momentum != 0.0 else None
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        mom, prev = state
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        comp = g + wd * weight + self.lamda * g * g * (weight - prev)
+        if mom is None:
+            step = (-lr) * comp
+        else:
+            mom *= self.momentum
+            mom -= lr * comp
+            step = mom
+        prev._data = weight.data
+        prev._version += 1
+        weight += step
+
+
+@register
+class Adam(Optimizer):
+    """(reference: optimizer.py Adam; update op optimizer_op.cc adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        _invoke("adam_update", [weight, grad, mean, var], [weight, mean, var],
+                lr=lr, beta1=self.beta1, beta2=self.beta2,
+                epsilon=self.epsilon, wd=wd, **self._common_kwargs(index))
+
+
+@register
+class AdaGrad(Optimizer):
+    """(reference: optimizer.py AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        _invoke("adagrad_update", [weight, grad, state], [weight, state],
+                lr=lr, wd=wd, epsilon=self.float_stable_eps,
+                **self._common_kwargs(index))
+
+
+@register
+class RMSProp(Optimizer):
+    """(reference: optimizer.py RMSProp — centered=True selects Graves'
+    variant rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, dtype=weight.dtype),
+                    nd.zeros(weight.shape, dtype=weight.dtype),
+                    nd.zeros(weight.shape, dtype=weight.dtype))
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            _invoke("rmspropalex_update", [weight, grad, n, g, delta],
+                    [weight, n, g, delta], lr=lr, gamma1=self.gamma1,
+                    gamma2=self.gamma2, epsilon=self.epsilon, wd=wd, **kw)
+        else:
+            _invoke("rmsprop_update", [weight, grad, state], [weight, state],
+                    lr=lr, gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                    **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    """(reference: optimizer.py AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        acc_g, acc_delta = state
+        _invoke("adadelta_update", [weight, grad, acc_g, acc_delta],
+                [weight, acc_g, acc_delta], rho=self.rho,
+                epsilon=self.epsilon, wd=wd, **self._common_kwargs(index))
+
+
+@register
+class Ftrl(Optimizer):
+    """(reference: optimizer.py Ftrl)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        z, n = state
+        _invoke("ftrl_update", [weight, grad, z, n], [weight, z, n],
+                lr=lr, lamda1=self.lamda1, beta=self.beta, wd=wd,
+                **self._common_kwargs(index))
+
+
+@register
+class Adamax(Optimizer):
+    """(reference: optimizer.py Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        mean, u = state
+        _invoke("adamax_update", [weight, grad, mean, u], [weight, mean, u],
+                lr=lr, beta1=self.beta1, beta2=self.beta2, wd=wd,
+                **self._common_kwargs(index))
+
+
+@register
+class Nadam(Optimizer):
+    """Adam with Nesterov momentum (reference: optimizer.py Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (
+            1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        mean, var = state
+        mean._data = (self.beta1 * mean + (1.0 - self.beta1) * g).data
+        var._data = (self.beta2 * var + (1.0 - self.beta2) * g * g).data
+        mean._version += 1
+        var._version += 1
+        g_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = mean / (1.0 - m_schedule_next)
+        v_t_prime = var / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_t_prime
+        weight -= lr * m_t_bar / (nd.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    """(reference: optimizer.py Test — simplest possible, for unit tests)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._data = weight.data
+        state._version += 1
+
+
+# ccSGD was a C++ twin of SGD in the reference (optimizer.py ccSGD)
+Optimizer.opt_registry["ccsgd"] = SGD
+
+
+class Updater(object):
+    """Applies an optimizer to indexed weights, creating per-index state
+    lazily (reference: optimizer.py:940 get_updater/Updater; serialized to
+    KVStore servers via set_optimizer)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states: bytes):
+        self.states = pickle.loads(states)
+
+    def get_states(self) -> bytes:
+        states = {}
+        for k, v in self.states.items():
+            states[k] = _state_to_np(v)
+        return pickle.dumps(states)
+
+
+def _state_to_np(v):
+    if v is None:
+        return None
+    if isinstance(v, NDArray):
+        return v.asnumpy()
+    if isinstance(v, tuple):
+        return tuple(_state_to_np(x) for x in v)
+    return v
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    """(reference: optimizer.py get_updater)."""
+    return Updater(optimizer)

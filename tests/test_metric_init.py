@@ -1,0 +1,135 @@
+"""Metric + initializer tests (reference: tests/python/unittest/
+test_metric.py, test_init.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 1])
+    m.update([label], [pred])
+    _, f1 = m.get()
+    assert abs(f1 - 1.0) < 1e-6
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([0.0, 4.0])
+    for name, expected in [("mse", (1 + 4) / 2.0), ("mae", (1 + 2) / 2.0),
+                           ("rmse", np.sqrt(2.5))]:
+        m = mx.metric.create(name)
+        m.update([label], [pred])
+        _, v = m.get()
+        assert abs(v - expected) < 1e-6, name
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    _, v = m.get()
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(v - expected) < 1e-5
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    names, values = m.get()
+    assert names == ["accuracy", "mse"]
+
+
+def test_custom_metric():
+    m = mx.metric.np(lambda label, pred: float(np.sum(label == pred.argmax(1))))
+    pred = mx.nd.array([[0.1, 0.9]])
+    label = mx.nd.array([1])
+    m.update([label], [pred])
+    _, v = m.get()
+    assert v == 1.0
+
+
+# ------------------------------------------------------------- initializers
+
+
+def test_xavier_scale():
+    np.random.seed(0)
+    arr = mx.nd.zeros((128, 64))
+    init = mx.init.Xavier(rnd_type="uniform", factor_type="avg", magnitude=3)
+    init("fc_weight", arr)
+    bound = np.sqrt(3.0 / ((128 + 64) / 2))
+    a = arr.asnumpy()
+    assert np.abs(a).max() <= bound + 1e-6
+    assert a.std() > bound / 4
+
+
+def test_initializer_dispatch():
+    init = mx.init.Uniform(0.1)
+    bias = mx.nd.ones((4,))
+    init("fc_bias", bias)
+    assert_almost_equal(bias, np.zeros(4, np.float32))
+    gamma = mx.nd.zeros((4,))
+    init("bn_gamma", gamma)
+    assert_almost_equal(gamma, np.ones(4, np.float32))
+    mvar = mx.nd.zeros((4,))
+    init("bn_moving_var", mvar)
+    assert_almost_equal(mvar, np.ones(4, np.float32))
+
+
+def test_orthogonal():
+    np.random.seed(0)
+    arr = mx.nd.zeros((16, 16))
+    mx.init.Orthogonal(scale=1.0)("w_weight", arr)
+    a = arr.asnumpy()
+    assert_almost_equal(a.dot(a.T), np.eye(16), rtol=1e-4, atol=1e-4)
+
+
+def test_mixed_and_constant():
+    init = mx.init.Mixed([".*bias", ".*"],
+                         [mx.init.Constant(7.0), mx.init.Uniform(0.1)])
+    b = mx.nd.zeros((3,))
+    init("fc_bias", b)
+    assert_almost_equal(b, np.full(3, 7.0, np.float32))
+
+
+def test_lstmbias():
+    arr = mx.nd.ones((8,))
+    mx.init.LSTMBias(forget_bias=1.0)("lstm_bias", arr)
+    expected = np.zeros(8, np.float32)
+    expected[2:4] = 1.0
+    assert_almost_equal(arr, expected)
+
+
+def test_load_initializer(tmp_path):
+    f = str(tmp_path / "p.params")
+    mx.nd.save(f, {"arg:fc_weight": mx.nd.array([[1.0, 2.0]])})
+    init = mx.init.Load(f, default_init=mx.init.Zero())
+    w = mx.nd.zeros((1, 2))
+    init("fc_weight", w)
+    assert_almost_equal(w, np.array([[1.0, 2.0]], np.float32))
+    other = mx.nd.ones((2,))
+    init("other_weight", other)
+    assert_almost_equal(other, np.zeros(2, np.float32))

@@ -1,0 +1,140 @@
+"""Optimizer tests — numpy reference updates vs the registered update ops
+(reference test model: tests/python/unittest/test_optimizer.py compares the
+python Updater against the C++ update ops)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run(opt, w0, g, steps=3):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for _ in range(steps):
+        opt.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_plain_matches_numpy():
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    g = np.array([0.1, 0.2, -0.3], np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.01, rescale_grad=0.5)
+    got = _run(opt, w0, g)
+    w = w0.copy()
+    for _ in range(3):
+        w -= 0.1 * (0.5 * g + 0.01 * w)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, -0.5], np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    got = _run(opt, w0, g, steps=4)
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for _ in range(4):
+        mom = 0.9 * mom - 0.1 * g
+        w += mom
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    np.random.seed(0)
+    w0 = np.random.randn(4).astype(np.float32)
+    g = np.random.randn(4).astype(np.float32)
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    got = _run(opt, w0, g, steps=5)
+    w = w0.copy().astype(np.float64)
+    m = np.zeros(4)
+    v = np.zeros(4)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 6):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w -= lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w.astype(np.float32), rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop_matches_numpy():
+    w0 = np.array([0.5, 1.5], np.float32)
+    g = np.array([0.3, -0.2], np.float32)
+    opt = mx.optimizer.RMSProp(learning_rate=0.01, gamma1=0.9)
+    got = _run(opt, w0, g, steps=3)
+    w = w0.astype(np.float64)
+    n = np.zeros(2)
+    for _ in range(3):
+        n = 0.1 * g * g + 0.9 * n
+        w -= 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(got, w.astype(np.float32), rtol=1e-4, atol=1e-6)
+
+
+def test_adagrad_matches_numpy():
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.5, 0.1], np.float32)
+    opt = mx.optimizer.AdaGrad(learning_rate=0.1)
+    got = _run(opt, w0, g, steps=3)
+    w = w0.astype(np.float64)
+    h = np.zeros(2)
+    for _ in range(3):
+        h += g * g
+        w -= 0.1 * g / np.sqrt(h + 1e-7)
+    assert_almost_equal(got, w.astype(np.float32), rtol=1e-4, atol=1e-6)
+
+
+def test_clip_gradient():
+    w0 = np.array([0.0], np.float32)
+    g = np.array([100.0], np.float32)
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=1.0)
+    got = _run(opt, w0, g, steps=1)
+    assert_almost_equal(got, np.array([-1.0], np.float32))
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert opt._get_lr(0) == 1.0
+    opt.num_update = 25
+    lr = sched(25)
+    assert lr == 0.25
+
+
+def test_multifactor_scheduler():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    sched.base_lr = 1.0
+    assert sched(3) == 1.0
+    assert abs(sched(10) - 0.1) < 1e-9
+    assert abs(sched(20) - 0.01) < 1e-9
+
+
+def test_updater_and_registry():
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array([1.0])
+    upd(0, mx.nd.array([1.0]), w)
+    assert_almost_equal(w, np.array([0.5], np.float32))
+    states = upd.get_states()
+    assert isinstance(states, bytes)
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           param_idx2name={0: "w_weight", 1: "b_bias"})
+    opt.set_lr_mult({"w_weight": 0.1})
+    opt.set_wd_mult({})
+    assert abs(opt._get_lr(0) - 0.1) < 1e-9
+    assert opt._get_wd(1) == 0.0   # bias wd_mult defaults to 0
+
+
+def test_multi_precision_sgd():
+    w = mx.nd.array(np.array([1.0, 2.0]), dtype=np.float16)
+    g = mx.nd.array(np.array([0.5, 0.5]), dtype=np.float16)
+    opt = mx.optimizer.SGD(learning_rate=0.1, multi_precision=True)
+    state = opt.create_state(0, w)
+    assert isinstance(state, tuple)
+    assert state[1].dtype == np.float32
+    opt.update(0, w, g, state)
+    assert w.dtype == np.float16
+    assert_almost_equal(w, np.array([0.95, 1.95], np.float16), rtol=1e-2,
+                        atol=1e-3)
