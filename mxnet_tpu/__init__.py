@@ -33,6 +33,8 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import model
+from . import io
+from . import recordio
 from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constant
 
 __version__ = "0.1.0"

@@ -1,0 +1,471 @@
+"""Data iterators.
+
+Reference: ``python/mxnet/io.py`` (DataIter/DataBatch/DataDesc:40-274,
+NDArrayIter:513, PrefetchingIter:340, ResizeIter:275, MXDataIter:719) and the
+C++ registered iterators ``MNISTIter`` (src/io/iter_mnist.cc:259), ``CSVIter``
+(src/io/iter_csv.cc:150) — re-implemented host-side in Python/numpy feeding
+the device via async transfers (SURVEY.md §7 step 5). The threaded prefetch
+pipeline (dmlc::ThreadedIter, src/io/iter_prefetcher.h:46) is a background
+thread + bounded queue in :class:`PrefetchingIter`.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from ..base import MXNetError
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """(reference: io.py DataDesc — name/shape/dtype/layout of one stream)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), np.dtype(dtype), layout)
+
+    @staticmethod
+    def get_batch_axis(layout: Optional[str]) -> int:
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types=None):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch(object):
+    """(reference: io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data] if self.data else None
+        label_shapes = [l.shape for l in self.label] if self.label else None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter(object):
+    """Base iterator (reference: io.py:40 DataIter)."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input to list of (name, numpy array) (reference: io.py
+    _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d
+                    for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
+                        "them or dict with them as values")
+    out = {}
+    for k, v in data.items():
+        out[k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+    return list(sorted(out.items()))
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py:513 — shuffle,
+    last_batch_handle pad/discard/roll_over)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+
+        self.idx = np.arange(self.data[0][1].shape[0])
+        if shuffle:
+            np.random.shuffle(self.idx)
+            self.data = [(k, v[self.idx]) for k, v in self.data]
+            self.label = [(k, v[self.idx]) for k, v in self.label]
+
+        if last_batch_handle == "discard":
+            new_n = self.data[0][1].shape[0] - \
+                self.data[0][1].shape[0] % batch_size
+            self.idx = self.idx[:new_n]
+
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.num_data = self.idx.shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size"
+        self.cursor = -batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [nd.array(v[self.cursor:self.cursor + self.batch_size],
+                             dtype=v.dtype)
+                    for _, v in data_source]
+        # padding with wrap-around (reference: io.py NDArrayIter _getdata)
+        pad = self.batch_size - self.num_data + self.cursor
+        return [nd.array(np.concatenate([v[self.cursor:], v[:pad]], axis=0),
+                         dtype=v.dtype)
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def getindex(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self.idx[self.cursor:end]
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches per epoch
+    (reference: io.py:275)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffered prefetch over one or more iterators via background
+    threads (reference: io.py:340 PrefetchingIter ≡ dmlc::ThreadedIter,
+    src/io/iter_prefetcher.h:46-147)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth: int = 2):
+        super().__init__()
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self._queues = [queue.Queue(maxsize=prefetch_depth)
+                        for _ in range(self.n_iter)]
+        self._started = True
+        self._threads = []
+        for i in range(self.n_iter):
+            t = threading.Thread(target=self._worker, args=(i,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._reset_events = [threading.Event() for _ in range(self.n_iter)]
+
+    def _worker(self, i):
+        while self._started:
+            try:
+                batch = self.iters[i].next()
+                self._queues[i].put(("data", batch))
+            except StopIteration:
+                self._queues[i].put(("stop", None))
+                # wait for reset signal
+                while self._started:
+                    if getattr(self, "_reset_events", None) and \
+                            self._reset_events[i].wait(timeout=0.05):
+                        self._reset_events[i].clear()
+                        break
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        # drain queues, reset underlying iters, wake workers
+        for q in self._queues:
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        for it in self.iters:
+            it.reset()
+        for e in self._reset_events:
+            e.set()
+
+    def next(self):
+        batches = []
+        for q in self._queues:
+            kind, batch = q.get()
+            if kind == "stop":
+                raise StopIteration
+            batches.append(batch)
+        data = sum([b.data for b in batches], [])
+        label = sum([(b.label or []) for b in batches], [])
+        return DataBatch(data=data, label=label or None,
+                         pad=batches[0].pad, index=batches[0].index,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def __del__(self):
+        self._started = False
+        for e in getattr(self, "_reset_events", []):
+            e.set()
+
+
+class CSVIter(DataIter):
+    """Iterate CSV files (reference: src/io/iter_csv.cc:150 — data_csv,
+    data_shape, label_csv, batch_size, round_batch)."""
+
+    def __init__(self, data_csv: str, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 dtype=np.float32, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_shape = tuple(label_shape)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        self._data = data.reshape((-1,) + self.data_shape)
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=dtype, ndmin=2)
+            self._label = label.reshape((-1,) + self.label_shape)
+            if self.label_shape == (1,):
+                self._label = self._label.reshape(-1)
+        else:
+            self._label = np.zeros(self._data.shape[0], dtype=dtype)
+        self.round_batch = round_batch
+        self._iter = NDArrayIter(
+            self._data, self._label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard",
+            data_name="data", label_name="label")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def iter_next(self):
+        return self._iter.iter_next()
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getpad(self):
+        return self._iter.getpad()
+
+    def getindex(self):
+        return self._iter.getindex()
+
+
+def _read_idx_file(path: str, expected_magic_dims):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xff
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (reference: src/io/iter_mnist.cc:259 —
+    image=, label=, batch_size, shuffle, flat, seed, silent)."""
+
+    def __init__(self, image: str, label: str, batch_size=128, shuffle=True,
+                 flat=False, seed=0, silent=False, input_shape=None, **kwargs):
+        super().__init__(batch_size)
+        images = _read_idx_file(image, 3).astype(np.float32) / 255.0
+        labels = _read_idx_file(label, 1).astype(np.float32)
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        elif input_shape is not None:
+            images = images.reshape((-1,) + tuple(input_shape))
+        else:
+            images = images.reshape(images.shape[0], 1,
+                                    images.shape[1], images.shape[2])
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            order = rng.permutation(images.shape[0])
+            images, labels = images[order], labels[order]
+        self._iter = NDArrayIter(images, labels, batch_size=batch_size,
+                                 last_batch_handle="discard",
+                                 data_name="data", label_name="label")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def iter_next(self):
+        return self._iter.iter_next()
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getpad(self):
+        return self._iter.getpad()
+
+    def getindex(self):
+        return self._iter.getindex()

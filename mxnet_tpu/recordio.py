@@ -1,0 +1,200 @@
+"""RecordIO — the dataset container format.
+
+Reference: ``python/mxnet/recordio.py`` (MXRecordIO:36, MXIndexedRecordIO,
+IRHeader, pack/unpack/pack_img/unpack_img) over dmlc-core's RecordIO codec
+(SURVEY.md §2.8, §2.11; design doc docs/architecture/note_data_loading.md).
+
+Binary layout (dmlc recordio): per record a uint32 magic ``0xced7230a``, a
+uint32 ``lrecord`` whose upper 3 bits are a continuation flag and lower 29
+bits the payload length, then the payload padded to 4-byte alignment. This
+implementation writes single-part records (cflag=0) and reads multi-part
+ones.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+
+
+class MXRecordIO(object):
+    """Sequential record file reader/writer (reference: recordio.py:36)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def reset(self):
+        """(reference: recordio.py reset — reopen for reading)."""
+        self.close()
+        self.open()
+
+    def write(self, buf: bytes):
+        """(reference: recordio.py write)."""
+        assert self.writable
+        length = len(buf)
+        self.handle.write(struct.pack("<II", _kMagic, length & ((1 << 29) - 1)))
+        self.handle.write(buf)
+        pad = (-length) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        """(reference: recordio.py read). Returns None at EOF."""
+        assert not self.writable
+        parts = []
+        while True:
+            header = self.handle.read(8)
+            if len(header) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _kMagic:
+                raise IOError("Invalid magic number in record file %s" % self.uri)
+            cflag = lrec >> 29
+            length = lrec & ((1 << 29) - 1)
+            data = self.handle.read(length)
+            pad = (-length) % 4
+            if pad:
+                self.handle.read(pad)
+            parts.append(data)
+            # cflag: 0 = whole record; 1 = begin; 2 = middle; 3 = end
+            if cflag in (0, 3):
+                return b"".join(parts)
+
+    def tell(self) -> int:
+        return self.handle.tell()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access record file keyed by an index sidecar (reference:
+    recordio.py MXIndexedRecordIO; idx file = "key\\toffset" lines)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys: List = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.handle is not None and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        """(reference: recordio.py seek)."""
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx) -> bytes:
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        """(reference: recordio.py write_idx)."""
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a (header, payload) into a record string (reference:
+    recordio.py pack). Multi-label: header.label is an array and header.flag
+    its length."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        buf = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                          header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        buf = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+        buf += label.tobytes()
+    return buf + s
+
+
+def unpack(s: bytes):
+    """(reference: recordio.py unpack). Returns (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[: header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header: IRHeader, img, quality: int = 95,
+             img_fmt: str = ".jpg") -> bytes:
+    """Encode image + pack (reference: recordio.py pack_img, OpenCV path)."""
+    import cv2
+    encode_params = None
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s: bytes, iscolor: int = -1):
+    """(reference: recordio.py unpack_img). Returns (IRHeader, BGR ndarray)."""
+    import cv2
+    header, s = unpack(s)
+    img = np.frombuffer(s, dtype=np.uint8)
+    img = cv2.imdecode(img, iscolor)
+    return header, img

@@ -1,0 +1,184 @@
+"""Data IO tests (reference: tests/python/unittest/test_io.py,
+test_recordio.py)."""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    label = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[-1].pad == 2
+    # padded batch wraps around
+    assert_almost_equal(batches[-1].data[0].asnumpy()[2:], data[:2])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    data = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(data, None, batch_size=3,
+                           last_batch_handle="discard", shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in batches])
+    assert len(set(seen.astype(int))) == 9
+
+
+def test_ndarray_iter_dict_input():
+    it = mx.io.NDArrayIter({"a": np.zeros((6, 2)), "b": np.ones((6, 3))},
+                           np.arange(6), batch_size=2)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    b = next(it)
+    assert len(b.data) == 2
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(8, 3).astype(np.float32)
+    label = np.arange(8, dtype=np.float32)
+    np.savetxt(tmp_path / "d.csv", data, delimiter=",")
+    np.savetxt(tmp_path / "l.csv", label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(tmp_path / "d.csv"), data_shape=(3,),
+                       label_csv=str(tmp_path / "l.csv"), batch_size=4)
+    b = next(it)
+    assert b.data[0].shape == (4, 3)
+    assert_almost_equal(b.data[0], data[:4], rtol=1e-5, atol=1e-6)
+
+
+def _write_idx(path, arr):
+    ndim = arr.ndim
+    magic = 0x800 + ndim if arr.dtype == np.uint8 else 0x800 + ndim
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", (0x08 << 8) | ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnist_iter(tmp_path):
+    images = (np.random.rand(20, 28, 28) * 255).astype(np.uint8)
+    labels = (np.arange(20) % 10).astype(np.uint8)
+    _write_idx(tmp_path / "img", images)
+    _write_idx(tmp_path / "lbl", labels)
+    it = mx.io.MNISTIter(image=str(tmp_path / "img"),
+                         label=str(tmp_path / "lbl"),
+                         batch_size=5, shuffle=False, flat=False)
+    b = next(it)
+    assert b.data[0].shape == (5, 1, 28, 28)
+    assert b.label[0].shape == (5,)
+    assert_almost_equal(b.data[0].asnumpy()[0, 0], images[0] / 255.0,
+                        rtol=1e-5, atol=1e-6)
+    flat_it = mx.io.MNISTIter(image=str(tmp_path / "img"),
+                              label=str(tmp_path / "lbl"),
+                              batch_size=5, shuffle=False, flat=True)
+    b = next(flat_it)
+    assert b.data[0].shape == (5, 784)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    rec = mx.recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"abc\x00def"]
+    for p in payloads:
+        rec.write(p)
+    rec.close()
+    rec = mx.recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        r = rec.read()
+        if r is None:
+            break
+        got.append(r)
+    # empty payload reads back as empty bytes
+    assert got == [b"hello", b"x" * 1000, b"", b"abc\x00def"]
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        rec.write_idx(i, b"rec%d" % i)
+    rec.close()
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert rec.read_idx(3) == b"rec3"
+    assert rec.read_idx(0) == b"rec0"
+    assert rec.keys == [0, 1, 2, 3, 4]
+
+
+def test_pack_unpack_header():
+    h = mx.recordio.IRHeader(0, 3.0, 7, 0)
+    s = mx.recordio.pack(h, b"payload")
+    h2, payload = mx.recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 7
+    # multi-label
+    h = mx.recordio.IRHeader(4, np.array([1, 2, 3, 4], np.float32), 9, 0)
+    h2, payload = mx.recordio.unpack(mx.recordio.pack(h, b"z"))
+    assert_almost_equal(h2.label, np.array([1, 2, 3, 4], np.float32))
+    assert payload == b"z"
+
+
+def test_image_record_iter(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    path = str(tmp_path / "img.rec")
+    rec = mx.recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        img = (rng.rand(12, 12, 3) * 255).astype(np.uint8)
+        rec.write(mx.recordio.pack_img(
+            mx.recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=4, shuffle=False,
+                               preprocess_threads=2)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 8, 8)
+    assert b.label[0].shape == (4,)
+    assert_almost_equal(b.label[0], np.array([0.0, 1.0, 2.0, 0.0]))
+    n = 1
+    try:
+        while True:
+            b = next(it)
+            n += 1
+    except StopIteration:
+        pass
+    assert n == 3  # 10 imgs / bs 4 -> 2 full + 1 padded
+    it.reset()
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 8, 8)
+
+
+def test_prefetching_iter():
+    data = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mx.io.NDArrayIter(data, np.arange(12), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    batches = []
+    try:
+        while True:
+            batches.append(it.next())
+    except StopIteration:
+        pass
+    assert len(batches) == 3
+    assert_almost_equal(batches[0].data[0], data[:4])
+    it.reset()
+    b2 = it.next()
+    assert_almost_equal(b2.data[0], data[:4])
+
+
+def test_resize_iter():
+    data = np.zeros((8, 2), np.float32)
+    base = mx.io.NDArrayIter(data, None, batch_size=4)
+    it = mx.io.ResizeIter(base, 5)
+    assert len(list(it)) == 5
