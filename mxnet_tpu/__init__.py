@@ -35,6 +35,9 @@ from . import callback
 from . import model
 from . import io
 from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import parallel
 from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constant
 
 __version__ = "0.1.0"

@@ -1,0 +1,215 @@
+"""KVStore — the gradient-exchange / parameter-synchronization surface.
+
+Reference: ``include/mxnet/kvstore.h:44-348`` + ``src/kvstore/`` (SURVEY.md
+§2.7): ``local`` aggregates on CPU, ``device`` on GPUs with P2P,
+``dist_sync``/``dist_async`` ride a ps-lite parameter server.
+
+TPU design (SURVEY §2.7 translation): the *API* (init/push/pull/set_updater/
+rank/barrier) is kept so Module/Trainer code is parallelism-agnostic, but
+aggregation is XLA arithmetic:
+
+* ``local``/``device`` — multi-device values are summed with jnp adds; under
+  a jitted data-parallel step the same reduction is a mesh ``psum`` riding
+  ICI (see mxnet_tpu/parallel/).
+* ``dist_sync``/``dist_async`` — multi-host via ``jax.distributed``: every
+  process runs the same SPMD program, rank/size map to
+  ``jax.process_index/process_count``, and cross-host reduction happens in
+  the compiled collective — there is no separate server process to run, so
+  ``RunServer``/server-command plumbing reduces to no-ops kept for API parity
+  (an explicitly non-idiomatic PS mode is descoped, SURVEY §5.8).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+import jax
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from .base import MXNetError
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    single = not isinstance(key, (list, tuple))
+    return ([key] if single else list(key)), single
+
+
+def _val_list(value, n_keys):
+    """Normalize to list-of-lists: per key, a list of per-device values."""
+    if not isinstance(value, (list, tuple)):
+        value = [value]
+    if n_keys == 1:
+        if all(isinstance(v, NDArray) for v in value):
+            return [list(value)]
+    out = []
+    for v in value:
+        out.append(list(v) if isinstance(v, (list, tuple)) else [v])
+    return out
+
+
+class KVStore(object):
+    """(reference: python/mxnet/kvstore.py:62 KVStore; C++ api
+    include/mxnet/kvstore.h:44)."""
+
+    def __init__(self, kind: str):
+        self._kind = kind
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._updater_obj: Optional[opt.Updater] = None
+
+    # ------------------------------------------------------------ topology
+    @property
+    def type(self) -> str:
+        return self._kind
+
+    @property
+    def rank(self) -> int:
+        """(reference: kvstore.h get_rank)."""
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self) -> int:
+        """(reference: kvstore.h get_group_size)."""
+        try:
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def barrier(self):
+        """Global barrier (reference: kvstore.h Barrier). All outstanding
+        device work is flushed; with multiple processes the next collective
+        synchronizes them."""
+        nd.waitall()
+
+    # ------------------------------------------------------------ data
+    def init(self, key, value):
+        """(reference: kvstore.py init — run once per key before push/pull)."""
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority: int = 0):
+        """Aggregate (sum) pushed values; if an updater is set, apply it to
+        the stored weight (reference: kvstore.py push; local reduce
+        src/kvstore/comm.h:85; server-side update
+        kvstore_dist_server.h:164-230)."""
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = vlist[0]
+            if len(vlist) > 1:
+                acc = merged.data
+                dev = acc.device if hasattr(acc, "device") else None
+                for v in vlist[1:]:
+                    d = v.data
+                    if dev is not None and getattr(d, "device", None) != dev:
+                        d = jax.device_put(d, dev)
+                    acc = acc + d
+                merged = NDArray(acc)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._data = self._store[k].data + merged.data
+                self._store[k]._version += 1
+
+    def pull(self, key, out=None, priority: int = 0):
+        """Copy stored weights into out arrays (reference: kvstore.py pull;
+        broadcast src/kvstore/kvstore_local.h:92-119)."""
+        assert out is not None
+        keys, _ = _key_list(key)
+        if len(keys) == 1:
+            outs = [out] if isinstance(out, NDArray) else list(out)
+            outs = [outs]
+        else:
+            outs = []
+            for o in out:
+                outs.append([o] if isinstance(o, NDArray) else list(o))
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    # ------------------------------------------------------------ updater
+    def set_updater(self, updater: Callable):
+        """(reference: kvstore.py _set_updater)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer: opt.Optimizer):
+        """(reference: kvstore.py set_optimizer — in dist mode the reference
+        pickles the optimizer to the servers; here every process constructs
+        the same updater locally, which is the SPMD equivalent)."""
+        self._updater_obj = opt.get_updater(optimizer)
+        self._updater = self._updater_obj
+
+    # ------------------------------------------------------------ states
+    def save_optimizer_states(self, fname: str):
+        if self._updater_obj is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater_obj.get_states())
+
+    def load_optimizer_states(self, fname: str):
+        if self._updater_obj is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater_obj.set_states(fin.read())
+
+    # ------------------------------------------------------------ cluster
+    def send_command_to_servers(self, head: int, body: str):
+        """(reference: kvstore.h SendCommandToServers). No separate server
+        processes exist in the SPMD design; kept for API parity."""
+
+    def get_num_dead_node(self, node_id: int, timeout: int = 0) -> int:
+        """(reference: kvstore.h:287 — ps-lite heartbeat probe). The JAX
+        distributed runtime surfaces failures as errors, not liveness polls;
+        a live store reports zero dead nodes."""
+        return 0
+
+    @staticmethod
+    def is_worker_node() -> bool:
+        return True
+
+    @staticmethod
+    def is_server_node() -> bool:
+        return False
+
+    @staticmethod
+    def is_scheduler_node() -> bool:
+        return False
+
+
+def create(name: str = "local") -> KVStore:
+    """Factory (reference: src/kvstore/kvstore.cc:34-61 — substring grammar:
+    'device' → device-side reduce, 'dist' → multi-process, '_async' → async
+    server mode which is descoped on TPU to sync SPMD)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "local_allreduce_cpu", "local_update_cpu", "device",
+             "dist_sync", "dist_dev_sync", "dist_device_sync", "dist_async",
+             "dist")
+    if name not in valid:
+        raise MXNetError("Unknown KVStore type %r" % name)
+    if "dist" in name:
+        # multi-host rendezvous (no-op when jax.distributed already
+        # initialized by the launcher, or single-process)
+        try:
+            if jax.process_count() == 1:
+                pass
+        except Exception:
+            pass
+    return KVStore(name)

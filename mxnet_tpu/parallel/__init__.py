@@ -1,0 +1,21 @@
+"""mx.parallel — mesh sharding, collectives and sequence parallelism.
+
+The TPU-native replacement for the reference's distribution stack
+(SURVEY.md §2.7 KVStore comm, §2.12 ps-lite, §2.21 parallelism checklist):
+
+* data parallel  → batch sharded over a ``data`` mesh axis (mesh.py)
+* tensor parallel → parameters sharded over a ``model`` axis (GSPMD)
+* model parallel (group2ctx) → per-arg device shardings (executor.py)
+* sequence parallel / long context → ring attention (ring_attention.py)
+* multi-host → ``jax.distributed`` + the same mesh spanning hosts
+"""
+from .mesh import (make_mesh, data_parallel_mesh, batch_sharding,
+                   replicated_sharding, shard_batch, replicate, P, Mesh,
+                   NamedSharding, mesh_devices)
+from .ring_attention import (ring_attention, ring_self_attention,
+                             local_attention_block)
+
+__all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
+           "replicated_sharding", "shard_batch", "replicate", "P", "Mesh",
+           "NamedSharding", "mesh_devices", "ring_attention",
+           "ring_self_attention", "local_attention_block"]

@@ -1,0 +1,93 @@
+"""Device-mesh utilities — the TPU-native distribution substrate.
+
+Reference translation (SURVEY.md §2.21): the reference's
+DataParallelExecutorGroup (python/mxnet/module/executor_group.py:99) manually
+slices batches across a ctx list and KVStore Comm (src/kvstore/comm.h) sums
+gradients device-by-device. On TPU the same capabilities are sharding
+annotations on ONE jitted program over a ``jax.sharding.Mesh``: the batch is
+sharded over the ``data`` axis, parameters are replicated (or sharded over
+``model`` for tensor parallelism), and XLA inserts the psum/all-gather
+collectives over ICI.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..context import Context
+
+__all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
+           "replicated_sharding", "shard_batch", "replicate", "P", "Mesh",
+           "NamedSharding", "mesh_devices"]
+
+
+def mesh_devices(contexts: Optional[Sequence[Context]] = None) -> List[jax.Device]:
+    if contexts is not None:
+        return [c.jax_device for c in contexts]
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        # explicit CPU request (the virtual-mesh test rig, SURVEY.md §4) —
+        # some accelerator plugins register even when JAX_PLATFORMS says cpu
+        return list(jax.devices("cpu"))
+    return list(jax.devices())
+
+
+def make_mesh(shape: Optional[Dict[str, int]] = None,
+              contexts: Optional[Sequence[Context]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a named mesh.
+
+    ``shape`` maps axis name -> size, e.g. ``{"data": 4, "model": 2}``; a
+    size of -1 absorbs the remaining devices. Defaults to one ``data`` axis
+    over all visible devices.
+    """
+    devs = list(devices) if devices is not None else mesh_devices(contexts)
+    if shape is None:
+        shape = {"data": len(devs)}
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        # fall back to the host's virtual CPU devices — the TPU twin of the
+        # reference running multi-device suites on cpu(0)/cpu(1)
+        # (tests/python/unittest/test_multi_device_exec.py, SURVEY.md §4)
+        cpus = list(jax.devices("cpu"))
+        if devices is None and contexts is None and total <= len(cpus):
+            devs = cpus
+        else:
+            raise ValueError("mesh needs %d devices, only %d visible"
+                             % (total, len(devs)))
+    arr = np.array(devs[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(contexts: Sequence[Context]) -> Mesh:
+    """Mesh with a single ``data`` axis over a ctx list — the TPU twin of
+    Module(context=[...]) data parallelism."""
+    return make_mesh({"data": len(contexts)}, contexts=contexts)
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data", batch_dim: int = 0):
+    spec = [None] * (batch_dim + 1)
+    spec[batch_dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, value, axis: str = "data", batch_dim: int = 0):
+    """Place an array batch-sharded over the mesh."""
+    return jax.device_put(value, batch_sharding(mesh, axis, batch_dim))
+
+
+def replicate(mesh: Mesh, value):
+    """Place an array fully replicated over the mesh."""
+    return jax.device_put(value, replicated_sharding(mesh))

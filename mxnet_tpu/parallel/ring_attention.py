@@ -1,0 +1,128 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference predates long-context training (SURVEY.md §5.7: its sequence
+story is BucketingModule + fused RNN); the task spec requires the modern TPU
+capability: shard the sequence axis across devices and compute exact
+attention by rotating key/value blocks around the ring with ``ppermute``
+while accumulating an online softmax (blockwise attention), so no device
+ever materializes the full S×S score matrix. Collectives ride ICI
+neighbor-to-neighbor, overlapping with the per-block matmuls (the pattern
+from the ring-attention literature; see PAPERS.md).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["ring_attention", "ring_self_attention", "local_attention_block"]
+
+
+def local_attention_block(q, k, v, mask=None, scale=None):
+    """One (q-block, kv-block) attention contribution with running-softmax
+    statistics. Returns (o_unnormalized, row_sum l, row_max m)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isneginf(m)[..., None], 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype))
+    return o, l, m
+
+
+def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-shard body: q/k/v are the local sequence blocks
+    (B, H, S_local, D); rotate k/v around the ring, accumulate online
+    softmax."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+
+    o_acc = jnp.zeros((b, h, s_q, d), jnp.float32)
+    l_acc = jnp.zeros((b, h, s_q), jnp.float32)
+    m_acc = jnp.full((b, h, s_q), -jnp.inf, jnp.float32)
+
+    q_pos = my_idx * s_q + jnp.arange(s_q)
+
+    def body(i, carry):
+        o_acc, l_acc, m_acc, k_cur, v_cur = carry
+        kv_idx = (my_idx - i) % axis_size  # owner of the block we now hold
+        if causal:
+            k_pos = kv_idx * s_k + jnp.arange(s_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = mask[None, None]
+        else:
+            mask = None
+        o_blk, l_blk, m_blk = local_attention_block(q, k_cur, v_cur, mask,
+                                                    scale)
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m_blk)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m_acc), -jnp.inf,
+                                  m_acc - m_safe))
+        beta = jnp.exp(jnp.where(jnp.isneginf(m_blk), -jnp.inf,
+                                 m_blk - m_safe))
+        o_new = o_acc * alpha[..., None] + o_blk * beta[..., None]
+        l_new = l_acc * alpha + l_blk * beta
+        # rotate kv to the next device (neighbor exchange on ICI)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o_new, l_new, m_new, k_nxt, v_nxt
+
+    o_acc, l_acc, m_acc, _, _ = lax.fori_loop(
+        0, axis_size, body, (o_acc, l_acc, m_acc, k, v))
+    out = o_acc / jnp.maximum(l_acc, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Exact attention with the sequence axis sharded over ``axis_name``.
+
+    q, k, v: (B, H, S, D) arrays (global view); S is sharded over the mesh
+    axis. Returns (B, H, S, D) with the same sharding.
+    """
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_shard, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
+
+
+def ring_self_attention(x, w_qkv, w_out, mesh: Mesh, num_heads: int,
+                        axis_name: str = "sp", causal: bool = False):
+    """Full self-attention layer with sequence-parallel ring attention:
+    x (B, S, E) sharded on S; projections are local (no collective), only
+    the kv ring moves data."""
+    b, s, e = x.shape
+    d = e // num_heads
+    qkv = jnp.einsum("bse,ecf->bscf", x,
+                     w_qkv.reshape(e, 3, e)).reshape(b, s, 3, num_heads, d)
+    q = qkv[:, :, 0].transpose(0, 2, 1, 3)
+    k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+    v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+    o = ring_attention(q, k, v, mesh, axis_name=axis_name, causal=causal)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+    return jnp.einsum("bse,ef->bsf", o, w_out)

@@ -1,0 +1,146 @@
+"""KVStore semantics + mesh parallelism tests.
+
+Reference test model: tests/python/unittest/test_kvstore.py (local
+aggregation math) and tests/nightly/dist_sync_kvstore.py (pushed value *
+num_devices); multi-device on the virtual 8-CPU mesh per SURVEY.md §4.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+shape = (4, 4)
+
+
+def test_kvstore_init_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(shape))
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones(shape))
+
+
+def test_kvstore_push_aggregation():
+    # reference semantics: push of N device-values aggregates their sum
+    # (tests/python/unittest/test_kvstore.py test_single_kv_pair/list)
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.zeros(shape))
+    devs = [mx.cpu(i) for i in range(4)]
+    vals = [mx.nd.ones(shape, ctx=d) for d in devs]
+    kv.push(3, vals)
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, 4 * np.ones(shape))
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones(shape))
+
+    def updater(key, grad, weight):
+        weight -= 0.1 * grad
+
+    kv.set_updater(updater)
+    kv.push("w", [mx.nd.ones(shape)] * 2)   # merged grad = 2
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    assert_almost_equal(out, np.ones(shape) - 0.2, rtol=1e-5, atol=1e-6)
+
+
+def test_kvstore_list_keys():
+    kv = mx.kv.create("device")
+    keys = [5, 7, 9]
+    kv.init(keys, [mx.nd.ones(shape)] * 3)
+    kv.push(keys, [[mx.nd.ones(shape)] * 2] * 3)
+    outs = [mx.nd.zeros(shape) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        assert_almost_equal(o, 3 * np.ones(shape))
+
+
+def test_kvstore_optimizer_states(tmp_path):
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((2,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(0, mx.nd.ones((2,)))
+    f = str(tmp_path / "states")
+    kv.save_optimizer_states(f)
+    kv.load_optimizer_states(f)
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+# ---------------------------------------------------------------- mesh
+
+
+def test_make_mesh_shapes():
+    mesh = mx.parallel.make_mesh({"data": 4, "model": 2})
+    assert mesh.shape == {"data": 4, "model": 2}
+    mesh2 = mx.parallel.make_mesh({"data": -1})
+    assert mesh2.shape["data"] == len(mx.parallel.mesh_devices())
+
+
+def test_data_parallel_grad_matches_single_device():
+    """8-way data-parallel gradient == single-device gradient (SPMD psum
+    inserted by XLA; the capability the reference gets from
+    DataParallelExecutorGroup + KVStore)."""
+    np.random.seed(0)
+    w = np.random.randn(6, 3).astype(np.float32)
+    x = np.random.randn(16, 6).astype(np.float32)
+    y = np.random.randn(16, 3).astype(np.float32)
+
+    def loss(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    g_single = jax.grad(loss)(w, x, y)
+
+    mesh = mx.parallel.make_mesh({"data": 8})
+    xs = mx.parallel.shard_batch(mesh, x)
+    ys = mx.parallel.shard_batch(mesh, y)
+    wr = mx.parallel.replicate(mesh, w)
+    g_sharded = jax.jit(jax.grad(loss))(wr, xs, ys)
+    # fp32 reduction order differs between one-device sum and 8-way psum
+    assert_almost_equal(np.asarray(g_sharded), np.asarray(g_single),
+                        rtol=1e-2, atol=1e-4)
+
+
+def test_ring_attention_matches_full():
+    np.random.seed(1)
+    B, H, S, D = 2, 2, 16, 8
+    q = np.random.randn(B, H, S, D).astype(np.float32)
+    k = np.random.randn(B, H, S, D).astype(np.float32)
+    v = np.random.randn(B, H, S, D).astype(np.float32)
+
+    def full_attn(q, k, v, causal):
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            s = np.where(mask, s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+    mesh = mx.parallel.make_mesh({"sp": 8})
+    for causal in (False, True):
+        out = mx.parallel.ring_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,
+            axis_name="sp", causal=causal)
+        assert_almost_equal(np.asarray(out), full_attn(q, k, v, causal),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_gradient_flows():
+    B, H, S, D = 1, 1, 8, 4
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    q = jnp.asarray(np.random.randn(B, H, S, D).astype(np.float32))
+
+    def f(q):
+        return jnp.sum(mx.parallel.ring_attention(q, q, q, mesh,
+                                                  axis_name="sp"))
+
+    g = jax.grad(f)(q)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
