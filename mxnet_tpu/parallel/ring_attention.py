@@ -60,6 +60,10 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
     o_acc = jnp.zeros((b, h, s_q, d), jnp.float32)
     l_acc = jnp.zeros((b, h, s_q), jnp.float32)
     m_acc = jnp.full((b, h, s_q), -jnp.inf, jnp.float32)
+    if hasattr(lax, "pvary"):
+        # mark initial carries as varying over the ring axis so the scan
+        # carry types match (shard_map vma typing in recent jax)
+        o_acc, l_acc, m_acc = lax.pvary((o_acc, l_acc, m_acc), (axis_name,))
 
     q_pos = my_idx * s_q + jnp.arange(s_q)
 
@@ -106,8 +110,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     fn = shard_map(
         functools.partial(_ring_attention_shard, axis_name=axis_name,
                           causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 
