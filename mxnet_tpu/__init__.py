@@ -38,6 +38,8 @@ from . import recordio
 from . import kvstore
 from . import kvstore as kv
 from . import parallel
+from . import module
+from . import module as mod
 from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constant
 
 __version__ = "0.1.0"

@@ -1,0 +1,20 @@
+"""Module API — the symbolic training frontend.
+
+Reference: ``python/mxnet/module/`` (SURVEY.md §2.14): ``BaseModule`` owns the
+canonical ``fit()`` loop (base_module.py:376), ``Module`` binds a Symbol into
+executors, ``BucketingModule`` maps variable-length workloads onto a pool of
+modules sharing memory.
+
+TPU design: one bound module = one jitted XLA program per entry point; data
+parallelism over a context list = batch-sharded inputs over a
+``jax.sharding.Mesh`` with XLA inserting the gradient psum (replacing
+DataParallelExecutorGroup + KVStore device-comm, SURVEY.md §2.21); the fit
+hot loop runs a single fused forward+backward+optimizer-update program with
+donated buffers (SURVEY.md §7 "Hard parts": fit() must run fully jitted).
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule"]

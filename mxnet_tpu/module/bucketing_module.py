@@ -1,0 +1,254 @@
+"""BucketingModule — variable-length workloads without padding waste.
+
+Reference: ``python/mxnet/module/bucketing_module.py:35`` — keeps one Module
+per bucket key, all binding against the default bucket's module with
+``shared_module=`` so executors reuse one memory pool
+(graph_executor.cc:748-749).
+
+TPU design (SURVEY.md §7 "Hard parts — bucketing vs XLA recompilation"):
+each bucket is a distinct static shape ⇒ a distinct XLA executable. The
+module pool IS the bounded compile cache: parameters are shared by reference
+(the same jax.Arrays flow through every bucket's jitted program), so there is
+no per-bucket copy and no cross-bucket sync step. Choose bucket sets the way
+the reference docs advise (docs/how_to/bucketing.md): a handful of padded
+lengths, not one per observed length.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..context import cpu
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    """(reference: bucketing_module.py:35)."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context if context is not None else cpu()
+        self._work_load_list = work_load_list
+        self._fixed_param_names = fixed_param_names
+        self._state_names = state_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._params_dirty = False
+
+    def _reset_bind(self):
+        self.binded = False
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
+        return data_names
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
+        return symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._curr_module.output_shapes
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    def _call_sym_gen(self, bucket_key):
+        res = self._sym_gen(bucket_key)
+        if not isinstance(res, tuple):
+            raise ValueError("sym_gen must return (symbol, data_names, "
+                             "label_names)")
+        return res
+
+    # ------------------------------------------------------------- params
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        self._curr_module._params_dirty = self._params_dirty
+        params = self._curr_module.get_params()
+        self._params_dirty = False
+        return params
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        self._curr_module.init_params(initializer=initializer,
+                                      arg_params=arg_params,
+                                      aux_params=aux_params,
+                                      allow_missing=allow_missing,
+                                      force_init=force_init,
+                                      allow_extra=allow_extra)
+        self._params_dirty = False
+        self.params_initialized = True
+
+    # ------------------------------------------------------------- binding
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Bind the default bucket (reference: bucketing_module.py:355 —
+        other buckets bind lazily against it)."""
+        assert shared_module is None, \
+            "shared_module for BucketingModule is not supported"
+        if force_rebind:
+            self._reset_bind()
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        symbol, data_names, label_names = \
+            self._call_sym_gen(self._default_bucket_key)
+        module = Module(symbol, data_names, label_names, logger=self.logger,
+                        context=self._context,
+                        work_load_list=self._work_load_list,
+                        fixed_param_names=self._fixed_param_names,
+                        state_names=self._state_names)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, force_rebind=False, shared_module=None,
+                    grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """(reference: bucketing_module.py switch_bucket). New buckets share
+        the default module's parameter arrays by reference — the TPU form of
+        shared_module executor-memory sharing."""
+        assert self.binded, "call bind before switching bucket"
+        if bucket_key not in self._buckets:
+            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
+            module = Module(symbol, data_names, label_names,
+                            logger=self.logger, context=self._context,
+                            work_load_list=self._work_load_list,
+                            fixed_param_names=self._fixed_param_names,
+                            state_names=self._state_names)
+            module.bind(data_shapes, label_shapes, self._curr_module.for_training,
+                        self._curr_module.inputs_need_grad,
+                        force_rebind=False,
+                        shared_module=self._buckets[self._default_bucket_key])
+            if self._curr_module.optimizer_initialized:
+                module.borrow_optimizer(
+                    self._buckets[self._default_bucket_key])
+            self._buckets[bucket_key] = module
+
+        if bucket_key != self._curr_bucket_key:
+            # share parameter NDArrays by reference with the current module
+            curr = self._curr_module
+            nxt = self._buckets[bucket_key]
+            for n in nxt._param_names:
+                if n in curr._exec.arg_dict:
+                    nxt._exec.arg_dict[n] = curr._exec.arg_dict[n]
+            for n in nxt._aux_names:
+                if n in curr._exec.aux_dict:
+                    nxt._exec.aux_dict[n] = curr._exec.aux_dict[n]
+            nxt._arg_params = {k: nxt._exec.arg_dict[k]
+                               for k in nxt._param_names}
+            nxt._aux_params = {k: nxt._exec.aux_dict[k]
+                               for k in nxt._aux_names}
+            nxt.params_initialized = True
+            if nxt.optimizer_initialized and curr.optimizer_initialized:
+                nxt._fused_states = curr._fused_states
+                nxt._fused_num_update = curr._fused_num_update
+            self._curr_module = nxt
+            self._curr_bucket_key = bucket_key
+
+    def prepare(self, data_batch):
+        """Switch to the batch's bucket (reference: bucketing_module.py
+        prepare)."""
+        bucket_key = getattr(data_batch, "bucket_key", None)
+        if bucket_key is None:
+            return
+        self.switch_bucket(bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+
+    # ------------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring.")
+            return
+        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         force_init=force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod.borrow_optimizer(self._curr_module)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------- compute
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self.prepare(data_batch)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        self._curr_module.update()
+
+    def _fit_step(self, data_batch):
+        self.prepare(data_batch)
+        self._params_dirty = True
+        self._curr_module._fit_step(data_batch)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        assert self.binded and self.params_initialized
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)

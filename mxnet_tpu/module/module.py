@@ -1,0 +1,625 @@
+"""Module — binds a Symbol to devices and drives training.
+
+Reference: ``python/mxnet/module/module.py`` — ``Module`` (line 39):
+``bind:351`` creates a DataParallelExecutorGroup, ``init_optimizer:461``
+decides update_on_kvstore, ``forward:556``/``backward:598``/``update:615``
+drive the executors and the kvstore push/pull.
+
+TPU design (SURVEY.md §2.21 + §7): the per-device executor group collapses
+into ONE jitted program. ``context=[...]`` with more than one device builds a
+``data``-axis mesh; inputs are batch-sharded, parameters replicated, and the
+gradient all-reduce the reference routed through KVStore Comm
+(src/kvstore/comm.h:73-380) is inserted by XLA as a psum over ICI. The fit
+hot loop uses a fused forward+backward+optimizer-update program with donated
+buffers so weights never leave HBM.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context, cpu
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..executor import Executor, graph_function
+from ..initializer import InitDesc
+from ..model import _create_kvstore, load_checkpoint, save_checkpoint
+from .base_module import BaseModule, _check_input_names
+from ..io.io import DataDesc
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """A bound Symbol + parameters + optimizer (reference: module.py:39)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = cpu()
+        if isinstance(context, Context):
+            context = [context]
+        self._context: List[Context] = list(context)
+        # work_load_list existed to weight uneven GPUs
+        # (executor_group.py:99); a TPU mesh is homogeneous, accepted and
+        # ignored for API compatibility.
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) if fixed_param_names \
+            is not None else []
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = [n for n in label_names if n in arg_names]
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params: Optional[Dict[str, nd.NDArray]] = None
+        self._aux_params: Optional[Dict[str, nd.NDArray]] = None
+        self._params_dirty = False
+
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+
+        self._exec: Optional[Executor] = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+        self._mesh = None
+        self._fused = None          # jitted fused train step
+        self._fused_out = None      # outputs of the last fused step
+        self._fused_states = None   # optimizer-state pytree for fused path
+
+    # ------------------------------------------------------------- loading
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create a Module from a saved checkpoint (reference:
+        module.py:114)."""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """(reference: module.py:152)."""
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, *self.get_params())
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    # ------------------------------------------------------------- shapes
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._exec.outputs
+        return list(zip(self._output_names, [o.shape for o in outs]))
+
+    # ------------------------------------------------------------- params
+    def get_params(self):
+        """(reference: module.py get_params)."""
+        assert self.binded and self.params_initialized
+        self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def _sync_params_from_devices(self):
+        """Copy bound executor values back into _arg_params (reference:
+        module.py _sync_params_from_devices). One jax.Array is the single
+        source of truth here, so 'sync' is a dict refresh."""
+        if not self.binded or not self.params_initialized:
+            return
+        if self._exec is not None and self._params_dirty:
+            for n in self._param_names:
+                self._arg_params[n] = self._exec.arg_dict[n]
+            for n in self._aux_names:
+                self._aux_params[n] = self._exec.aux_dict[n]
+            self._params_dirty = False
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """(reference: module.py init_params — attr-driven InitDesc
+        dispatch)."""
+        assert self.binded, "call bind before initializing the parameters"
+        if self.params_initialized and not force_init:
+            return
+        attrs = self.symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    if cache_arr.shape != arr.shape:
+                        raise MXNetError(
+                            "shape mismatch for %s: %s vs %s"
+                            % (name, cache_arr.shape, arr.shape))
+                    arr[:] = cache_arr
+            elif cache is not None and not allow_missing:
+                raise RuntimeError("%s is not presented" % name)
+            elif initializer is not None:
+                initializer(InitDesc(name, attrs.get(name, None)), arr)
+
+        for name in self._param_names:
+            _impl(name, self._exec.arg_dict[name], arg_params)
+        for name in self._aux_names:
+            _impl(name, self._exec.aux_dict[name], aux_params)
+
+        self._arg_params = {n: self._exec.arg_dict[n]
+                            for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n]
+                            for n in self._aux_names}
+        self.params_initialized = True
+        self._params_dirty = False
+        if self._mesh is not None:
+            self._replicate_params()
+
+    def _replicate_params(self):
+        """Replicate parameters over the data-parallel mesh so one jitted
+        program serves all devices (replaces per-device param copies in
+        executor_group.py + kvstore broadcast)."""
+        from ..parallel.mesh import replicated_sharding
+        sh = replicated_sharding(self._mesh)
+        for d in (self._exec.arg_dict, self._exec.aux_dict):
+            for name, arr in d.items():
+                arr._data = jax.device_put(arr._data, sh)
+
+    # ------------------------------------------------------------- binding
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(reference: module.py:351). Shapes may be (name, shape) tuples or
+        DataDesc."""
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in data_shapes]
+        self._label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                              for x in label_shapes] if label_shapes else []
+
+        shape_hints = {d.name: d.shape for d in self._data_shapes}
+        shape_hints.update({d.name: d.shape for d in self._label_shapes
+                            if d.name in self._symbol.list_arguments()})
+
+        if len(self._context) > 1:
+            from ..parallel.mesh import data_parallel_mesh
+            self._mesh = data_parallel_mesh(self._context)
+        else:
+            self._mesh = None
+
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._state_names:
+                req[n] = "null"
+            elif n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+        self._grad_req = req
+
+        type_dict = {d.name: d.dtype for d in self._data_shapes +
+                     self._label_shapes}
+        self._exec = self._symbol.simple_bind(
+            self._context[0], grad_req=req, type_dict=type_dict,
+            **shape_hints)
+        self.binded = True
+
+        if self.params_initialized:
+            # params were set before bind (Module.load / set_params on an
+            # unbound module): push them into the fresh executor (reference:
+            # module.py:351 bind → exec_group.set_params)
+            self.init_params(arg_params=self._arg_params,
+                             aux_params=self._aux_params,
+                             allow_missing=False, force_init=True)
+
+        if shared_module is not None and shared_module.params_initialized:
+            self.init_params(arg_params=shared_module._arg_params,
+                             aux_params=shared_module._aux_params,
+                             allow_missing=False, force_init=True)
+
+    # ------------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """(reference: module.py:461 — builds kvstore, decides
+        update_on_kvstore, pickles the optimizer to dist servers)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        kvstore, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), arg_params)
+
+        batch_size = sum(d.shape[0] for d in self._data_shapes) or 1
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+            if optimizer.rescale_grad != rescale_grad:
+                self.logger.warning(
+                    "Optimizer created manually outside Module but "
+                    "rescale_grad is not normalized to 1.0/batch_size/"
+                    "num_workers (%s vs. %s).",
+                    optimizer.rescale_grad, rescale_grad)
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        optimizer.set_lr_mult({})
+        optimizer.set_wd_mult({})
+
+        if kvstore:
+            # init kvstore entries; with update_on_kvstore the optimizer runs
+            # inside the store (reference: model.py:106)
+            for idx, name in enumerate(self._param_names):
+                kvstore.init(idx, self._arg_params[name])
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+        self._build_fused_step()
+
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """(reference: module.py borrow_optimizer — bucketing support)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+        self._build_fused_step()
+
+    def save_optimizer_states(self, fname):
+        """(reference: module.py:761). With the fused step active, its state
+        pytree is the authoritative optimizer state."""
+        assert self.optimizer_initialized
+        import pickle
+        if self._fused is not None and self._fused_states is not None:
+            states = jax.tree_util.tree_map(np.asarray, self._fused_states)
+            with open(fname, "wb") as fout:
+                pickle.dump({"fused": states,
+                             "num_update": self._fused_num_update}, fout)
+        elif self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        """(reference: module.py load_optimizer_states)."""
+        assert self.optimizer_initialized
+        import pickle
+        with open(fname, "rb") as fin:
+            blob = fin.read()
+        try:
+            payload = pickle.loads(blob)
+        except Exception:
+            payload = None
+        if isinstance(payload, dict) and "fused" in payload \
+                and self._fused is not None:
+            self._fused_states = jax.tree_util.tree_map(
+                jnp.asarray, payload["fused"])
+            self._fused_num_update = payload["num_update"]
+            self._optimizer.num_update = payload["num_update"]
+        elif self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            self._updater.set_states(blob)
+
+    # ------------------------------------------------------------- fused fit
+    def _build_fused_step(self):
+        """Compile the fit hot loop: forward + backward + optimizer update as
+        ONE donated-buffer XLA program (SURVEY.md §7 'Hard parts').
+
+        The per-step python work reduces to: place the batch, call the
+        compiled function, swap the new param/state arrays in. With a mesh
+        bound, inputs arrive batch-sharded and GSPMD turns the parameter
+        gradients into psum-reduced replicated arrays — the collective the
+        reference scheduled manually in kvstore Comm.
+        """
+        if self._updater is None and not self._update_on_kvstore:
+            self._fused = None
+            return
+        if self._update_on_kvstore and self._kvstore is not None \
+                and "dist" in self._kvstore.type:
+            self._fused = None  # real parameter-server path: not fusable
+            return
+
+        optimizer = self._optimizer
+        fn = self._exec._fn
+        input_names = set(self._data_names) | set(self._label_names) \
+            | set(self._state_names)
+        # only grad-bearing params are differentiated + updated; fixed
+        # params (grad_req null, reference fixed_param_names) ride along as
+        # constants exactly like the eager update() path skips them
+        param_names = [n for n in self._param_names
+                       if self._grad_req.get(n, "null") != "null"]
+        frozen = [n for n in self._symbol.list_arguments()
+                  if n not in input_names and n not in param_names]
+        name2idx = {n: i for i, n in enumerate(self._param_names)}
+
+        # optimizer states are created eagerly (concrete zeros) and then
+        # threaded through the jitted step as a pytree
+        def make_states():
+            states = {}
+            for n in param_names:
+                s = optimizer.create_state(name2idx[n],
+                                           self._exec.arg_dict[n])
+                states[n] = jax.tree_util.tree_map(
+                    lambda x: x.data if isinstance(x, nd.NDArray) else x, s,
+                    is_leaf=lambda x: isinstance(x, nd.NDArray) or x is None)
+            return states
+
+        def step(params, states, aux, inputs, frozen_vals, key, lr, t):
+            def loss_fn(p):
+                outs, new_aux = fn({**p, **inputs, **frozen_vals}, aux, key,
+                                   True)
+                return outs, new_aux
+
+            (outs, new_aux), vjp = jax.vjp(loss_fn, params)
+            cts = [jnp.ones_like(o) for o in outs]
+            grads = vjp((cts, {k: jnp.zeros_like(v)
+                               for k, v in new_aux.items()}))[0]
+            new_params, new_states = {}, {}
+            for n in param_names:
+                w, s = optimizer.raw_update(
+                    name2idx[n], params[n], grads[n], states[n], lr=lr, t=t)
+                new_params[n] = w
+                new_states[n] = s
+            return outs, new_params, new_states, new_aux
+
+        self._fused_jit = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._fused_num_update = self._optimizer.num_update
+
+        def run(data_batch):
+            ex = self._exec
+            self._load_batch(data_batch)
+            params = {n: ex.arg_dict[n].data for n in param_names}
+            states = self._fused_states
+            aux = {n: a.data for n, a in ex.aux_dict.items()}
+            inputs = {n: ex.arg_dict[n].data for n in
+                      (set(self._data_names) | set(self._label_names)
+                       | set(self._state_names))
+                      if n in ex.arg_dict}
+            frozen_vals = {n: ex.arg_dict[n].data for n in frozen}
+            ex._step += 1
+            key = jax.random.fold_in(ex._base_key, ex._step)
+            self._fused_num_update += 1
+            t = self._fused_num_update
+            self._optimizer.num_update = t
+            if self._optimizer.lr_scheduler is not None:
+                lr = self._optimizer.lr_scheduler(t)
+            else:
+                lr = self._optimizer.lr
+            outs, new_params, new_states, new_aux = self._fused_jit(
+                params, states, aux, inputs, frozen_vals, key,
+                jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.int32))
+            for n in param_names:
+                ex.arg_dict[n]._data = new_params[n]
+                ex.arg_dict[n]._version += 1
+            for n, v in new_aux.items():
+                ex.aux_dict[n]._data = v
+                ex.aux_dict[n]._version += 1
+            self._fused_states = new_states
+            self._fused_out = [nd.NDArray(o) for o in outs]
+            ex._outputs = self._fused_out
+            ex._pending = None
+            self._params_dirty = True
+
+        if getattr(self, "_fused_states", None) is None or \
+                set(self._fused_states) != set(param_names):
+            self._fused_states = make_states()
+        self._fused = run
+
+    def _fit_step(self, data_batch):
+        """One fused train step; fit() uses this when available."""
+        if self._fused is None:
+            self.forward_backward(data_batch)
+            self.update()
+        else:
+            self._fused(data_batch)
+
+    # ------------------------------------------------------------- compute
+    def _load_batch(self, data_batch):
+        """Place batch data/labels into the bound args; with a mesh, inputs
+        are batch-sharded over the `data` axis (the TPU form of
+        _load_data/_load_label slicing in executor_group.py:31-75)."""
+        ex = self._exec
+        data = data_batch.data
+        labels = data_batch.label or []
+
+        def place(name, arr):
+            val = arr.data if isinstance(arr, nd.NDArray) else \
+                jnp.asarray(np.asarray(arr))
+            tgt = ex.arg_dict.get(name)
+            if tgt is None:
+                return
+            if val.dtype != tgt.data.dtype:
+                val = val.astype(tgt.data.dtype)
+            if self._mesh is not None:
+                from ..parallel.mesh import shard_batch
+                val = shard_batch(self._mesh, val)
+            else:
+                val = jax.device_put(val, self._context[0].jax_device)
+            tgt._data = val
+            tgt._version += 1
+
+        for name, arr in zip(self._data_names, data):
+            place(name, arr)
+        for name, arr in zip(self._label_names, labels):
+            place(name, arr)
+
+    def forward(self, data_batch, is_train=None):
+        """(reference: module.py:556)."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._load_batch(data_batch)
+        self._exec.forward(is_train=is_train)
+        if is_train:
+            self._params_dirty = True  # aux states may advance
+
+    def backward(self, out_grads=None):
+        """(reference: module.py:598)."""
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply gradients (reference: module.py:615 →
+        model.py:106 _update_params_on_kvstore)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        if self._kvstore is not None:
+            for idx, name in enumerate(self._param_names):
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                weight = self._exec.arg_dict[name]
+                self._kvstore.push(idx, grad)
+                if self._update_on_kvstore:
+                    self._kvstore.pull(idx, out=weight)
+                else:
+                    self._kvstore.pull(idx, out=grad)
+                    self._updater(idx, grad, weight)
+        else:
+            for idx, name in enumerate(self._param_names):
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._updater(idx, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        """(reference: module.py get_outputs). One program ⇒ already
+        merged."""
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        """(reference: module.py get_input_grads)."""
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        if states is not None:
+            for name, val in zip(self._state_names, states):
+                self._exec.arg_dict[name]._data = \
+                    val.data if isinstance(val, nd.NDArray) else \
+                    jnp.asarray(val)
+                self._exec.arg_dict[name]._version += 1
+        else:
+            for name in self._state_names:
+                arr = self._exec.arg_dict[name]
+                arr._data = jnp.full_like(arr.data, value)
+                arr._version += 1
+
+    def update_metric(self, eval_metric, labels):
+        """(reference: module.py update_metric → executor_group
+        update_metric)."""
+        labels = {name: arr for name, arr in
+                  zip(self._label_names or
+                      [d.name for d in self._label_shapes], labels)}
+        preds = dict(zip(self._output_names, self.get_outputs()))
+        eval_metric.update_dict(labels, preds)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """(reference: module.py reshape). Shapes re-bind lazily: XLA caches
+        one executable per shape signature."""
+        assert self.binded
+        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in data_shapes]
+        if label_shapes is not None:
+            self._label_shapes = [x if isinstance(x, DataDesc)
+                                  else DataDesc(*x) for x in label_shapes]
+        kw = {d.name: d.shape for d in self._data_shapes}
+        if label_shapes:
+            kw.update({d.name: d.shape for d in self._label_shapes})
+        self._exec = self._exec.reshape(**kw)
+        if self.optimizer_initialized:
+            self._build_fused_step()
+
+    def install_monitor(self, mon):
+        """(reference: module.py install_monitor)."""
+        assert self.binded
+        mon.install(self._exec)

@@ -155,9 +155,12 @@ class NDArray:
         """(reference: CopyFromTo src/ndarray/ndarray.cc:343-405 — the
         cross-device copy primitive; here one jax.device_put)."""
         if isinstance(other, Context):
-            return NDArray(jax.device_put(self._data, other.jax_device))
+            # same-device device_put is a no-op alias; force a real copy so
+            # the result never shares a (potentially later-donated) buffer
+            return NDArray(jax.device_put(self._data,
+                                          other.jax_device).copy())
         other._data = jax.device_put(
-            self._data.astype(other.dtype), other.context.jax_device)
+            self._data.astype(other.dtype), other.context.jax_device).copy()
         other._version += 1
         return other
 
@@ -186,14 +189,26 @@ class NDArray:
 
     def __setitem__(self, key, value):
         val = value._data if isinstance(value, NDArray) else value
+        # assignment writes INTO this array: the result must stay on this
+        # array's device/sharding regardless of where the source lives
+        # (reference: CopyFromTo picks the destination's context)
+        sharding = getattr(self._data, "sharding", None)
         if isinstance(key, slice) and key == slice(None):
             if np.isscalar(val):
                 self._data = jnp.full_like(self._data, val)
             else:
-                self._data = jnp.broadcast_to(
+                # .copy() so a full-slice assign never aliases the source
+                # buffer (donated-buffer safety, see copyto)
+                new = jnp.broadcast_to(
                     jnp.asarray(val, dtype=self._data.dtype), self.shape
-                ).astype(self._data.dtype)
+                ).astype(self._data.dtype).copy()
+                if sharding is not None and new.sharding != sharding:
+                    new = jax.device_put(new, sharding)
+                self._data = new
         else:
+            if isinstance(val, jax.Array) and sharding is not None \
+                    and getattr(val, "sharding", None) != sharding:
+                val = jax.device_put(val, sharding)
             self._data = self._data.at[key].set(val)
         # new buffer version: recorded tape entries keep the old value
         self._version += 1

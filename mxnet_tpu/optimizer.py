@@ -62,6 +62,11 @@ class Optimizer(object):
             param_idx2name = {}
         self.idx2name = param_idx2name.copy()
         self.sym = sym
+        # traced-mode overrides (see raw_update): when set, _get_lr/_update_
+        # count use these possibly-traced scalars instead of python floats so
+        # one XLA compilation serves every step of an LR schedule.
+        self._traced_lr = None
+        self._traced_t = None
 
     def create_state(self, index, weight):
         return None
@@ -95,13 +100,18 @@ class Optimizer(object):
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
+        if self._traced_t is not None:
+            self._index_update_count[index] = self._traced_t
+            return
         if index not in self._index_update_count:
             self._index_update_count[index] = self.begin_num_update
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
 
     def _get_lr(self, index) -> float:
-        if self.lr_scheduler is not None:
+        if self._traced_lr is not None:
+            lr = self._traced_lr
+        elif self.lr_scheduler is not None:
             lr = self.lr_scheduler(self.num_update)
         else:
             lr = self.lr
@@ -118,6 +128,46 @@ class Optimizer(object):
         elif index in self.idx2name:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
+
+    def raw_update(self, index, weight, grad, state, lr=None, t=None):
+        """Functionally apply this optimizer's update to raw (possibly
+        traced) jax arrays, returning ``(new_weight, new_state)``.
+
+        The TPU fit hot path (Module._fit_step) traces this inside ONE jitted
+        train step — the analogue of the reference running `sgd_mom_update`
+        engine ops right after the backward ops (SURVEY.md §2.5 optimizer
+        update ops, §7 "fit() must run fully jitted"). ``lr`` and the update
+        count ``t`` enter as traced scalars so LR schedules and Adam bias
+        correction do not force a recompile every step.
+        """
+        from .ndarray import NDArray
+
+        def wrap(v):
+            if v is None:
+                return None
+            if isinstance(v, tuple):
+                return tuple(wrap(x) for x in v)
+            return NDArray(v)
+
+        def unwrap(v):
+            if v is None:
+                return None
+            if isinstance(v, tuple):
+                return tuple(unwrap(x) for x in v)
+            return v._data
+
+        w, g, s = NDArray(weight), NDArray(grad), wrap(state)
+        self._traced_lr, self._traced_t = lr, t
+        saved_counts = dict(self._index_update_count)
+        saved_num_update = self.num_update
+        try:
+            self.update(index, w, g, s)
+        finally:
+            # don't leak traced scalars into persistent optimizer state
+            self._traced_lr = self._traced_t = None
+            self._index_update_count = saved_counts
+            self.num_update = saved_num_update
+        return w._data, unwrap(s)
 
     def _common_kwargs(self, index):
         kw = {"rescale_grad": self.rescale_grad}
@@ -158,7 +208,7 @@ class SGD(Optimizer):
             weight_master = weight.astype(np.float32)
         if self.momentum != 0.0:
             base = weight_master if weight_master is not None else weight
-            momentum = nd.zeros(base.shape, dtype=base.dtype)
+            momentum = nd.zeros(base.shape, dtype=base.dtype, ctx=base.context)
         if weight_master is not None:
             return (momentum, weight_master)
         return momentum
@@ -194,7 +244,7 @@ class NAG(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -229,7 +279,7 @@ class DCASGD(Optimizer):
         self.lamda = lamda
 
     def create_state(self, index, weight):
-        mom = nd.zeros(weight.shape, dtype=weight.dtype) \
+        mom = nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context) \
             if self.momentum != 0.0 else None
         return (mom, weight.copy())
 
@@ -262,8 +312,8 @@ class Adam(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, dtype=weight.dtype),
-                nd.zeros(weight.shape, dtype=weight.dtype))
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -287,7 +337,7 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -312,10 +362,10 @@ class RMSProp(Optimizer):
 
     def create_state(self, index, weight):
         if self.centered:
-            return (nd.zeros(weight.shape, dtype=weight.dtype),
-                    nd.zeros(weight.shape, dtype=weight.dtype),
-                    nd.zeros(weight.shape, dtype=weight.dtype))
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+            return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                    nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                    nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -343,8 +393,8 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, dtype=weight.dtype),
-                nd.zeros(weight.shape, dtype=weight.dtype))
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
 
     def update(self, index, weight, grad, state):
         wd = self._get_wd(index)
@@ -364,8 +414,8 @@ class Ftrl(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, dtype=weight.dtype),
-                nd.zeros(weight.shape, dtype=weight.dtype))
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -385,8 +435,8 @@ class Adamax(Optimizer):
         self.beta1, self.beta2 = beta1, beta2
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, dtype=weight.dtype),
-                nd.zeros(weight.shape, dtype=weight.dtype))
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -411,8 +461,8 @@ class Nadam(Optimizer):
         self.m_schedule = 1.0
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, dtype=weight.dtype),
-                nd.zeros(weight.shape, dtype=weight.dtype))
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -443,7 +493,7 @@ class Test(Optimizer):
     """(reference: optimizer.py Test — simplest possible, for unit tests)."""
 
     def create_state(self, index, weight):
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
 
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad
