@@ -1,0 +1,67 @@
+"""Driver benchmark: ResNet-50 fused training step, images/sec on one chip.
+
+Baseline: the reference's published training number for ResNet-50 at batch 32
+— 181.53 img/s on P100 (BASELINE.md, docs/how_to/perf.md:180-190). This
+script runs the same workload through the TPU-native stack: one fused
+forward+backward+SGD-update XLA program built by Module._build_fused_step.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N/181.53}
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0] if "/" in __file__ else ".")
+
+BASELINE_IMG_S = 181.53   # P100 training, ResNet-50 batch 32
+BATCH = 32
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+
+    ctx = mx.tpu(0) if mx.num_devices("tpu") else mx.cpu(0)
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50)
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9, "wd": 1e-4})
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (BATCH, 3, 224, 224)).astype(np.float32)
+    y = rng.randint(0, 1000, (BATCH,)).astype(np.float32)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x, ctx=ctx)],
+                            label=[mx.nd.array(y, ctx=ctx)])
+
+    for _ in range(WARMUP):
+        mod._fit_step(batch)
+    jax.block_until_ready(mod._exec.arg_dict["fc1_weight"].data)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        mod._fit_step(batch)
+    jax.block_until_ready(mod._exec.arg_dict["fc1_weight"].data)
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_batch32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,8 @@ from . import kvstore as kv
 from . import parallel
 from . import module
 from . import module as mod
+from . import gluon
+from . import models
 from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constant
 
 __version__ = "0.1.0"

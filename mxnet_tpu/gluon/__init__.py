@@ -1,0 +1,26 @@
+"""Gluon — the imperative high-level API.
+
+Reference: ``python/mxnet/gluon/`` (SURVEY.md §2.14): Block/HybridBlock
+containers, Parameter/ParameterDict, Trainer, nn/rnn layer catalogs, losses,
+data pipeline, model zoo.
+
+TPU design: ``hybridize()`` compiles forward (and, under autograd, backward)
+into jitted XLA programs — the CachedOp equivalent (see block.py).
+"""
+from . import block
+from . import nn
+from . import loss
+from . import parameter
+from . import trainer
+from . import utils
+from . import data
+from . import model_zoo
+from . import rnn
+
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+
+__all__ = ["nn", "rnn", "loss", "data", "utils", "model_zoo", "Parameter",
+           "ParameterDict", "DeferredInitializationError", "Block",
+           "HybridBlock", "SymbolBlock", "Trainer"]

@@ -1,0 +1,468 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: ``python/mxnet/gluon/block.py`` — ``Block`` (line 115) is the
+imperative layer container; ``HybridBlock`` (line 283) records a symbolic
+graph on first call and swaps in a ``CachedOp`` (``_build_cache:361``);
+``SymbolBlock`` (line 433) wraps an existing Symbol.
+
+TPU design: ``hybridize()`` compiles the block's forward into ONE jitted XLA
+program per input signature (the jit cache is the CachedOp). Under
+``autograd.record()`` the whole compiled forward is recorded as a single
+composite tape op, so ``backward()`` runs one ``jax.vjp`` over the compiled
+function — the CachedOp forward+backward speedup, the XLA way.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from ..ops.registry import OpDef
+from .. import autograd
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(object):
+    """Name manager for Blocks (reference: block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter: Dict[str, int] = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_prefix(hint)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        _BlockScope._current.value = self._old_scope
+
+
+_GLOBAL_NAME_COUNTS: Dict[str, int] = {}
+
+
+def _name_prefix(hint):
+    count = _GLOBAL_NAME_COUNTS.get(hint, 0)
+    _GLOBAL_NAME_COUNTS[hint] = count + 1
+    return "%s%d_" % (hint, count)
+
+
+def _flatten(args):
+    """Flatten nested list/tuple structure, returning (flat, fmt)."""
+    if isinstance(args, NDArray):
+        return [args], 0
+    if isinstance(args, (list, tuple)):
+        flat, fmts = [], []
+        for a in args:
+            f, fmt = _flatten(a)
+            flat.extend(f)
+            fmts.append(fmt)
+        return flat, fmts
+    return [args], -1
+
+
+def _regroup(flat, fmt):
+    if fmt == 0:
+        return flat[0], flat[1:]
+    if fmt == -1:
+        return flat[0], flat[1:]
+    ret = []
+    for f in fmt:
+        res, flat = _regroup(flat, f)
+        ret.append(res)
+    return ret, flat
+
+
+class Block(object):
+    """Base building block (reference: block.py:115)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: List[Block] = []
+        self._reg_params: Dict[str, Parameter] = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=i, block=repr(b).replace("\n", "\n  "))
+            for i, b in enumerate(self._children))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.register_child(value)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """``with self.name_scope():`` (reference: block.py name_scope)."""
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self) -> ParameterDict:
+        """All Parameters of this Block and its children (reference:
+        block.py collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        ret.update(self.params)
+        for child in self._children:
+            ret.update(child.collect_params())
+        return ret
+
+    def save_params(self, filename):
+        """(reference: block.py:216 save_params — full parameter names, the
+        v0.11 behavior; prefix-stripping arrived in later MXNet)."""
+        self.collect_params().save(filename)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        """(reference: block.py:240 load_params)."""
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra)
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """Initialize all parameters (reference: block.py initialize)."""
+        from .. import initializer as init_mod
+        self.collect_params().initialize(
+            init or init_mod.Uniform(), ctx, verbose,
+            force_reinit=force_reinit)
+
+    def hybridize(self, active=True):
+        """Activate graph compilation in child HybridBlocks."""
+        for child in self._children:
+            child.hybridize(active)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """A Block convertible to one compiled program (reference:
+    block.py:283)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None         # signature -> (jitted fn, OpDef)
+        self._in_sig = None
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def hybridize(self, active=True):
+        self._active = active
+        self._clear_cached_op()
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _clear_cached_op(self):
+        if getattr(self, "_cached_op", None) is not None:
+            self._cached_op = {}
+        else:
+            self._cached_op = {}
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s." % (str(block), str(type(block))))
+        super().register_child(block)
+        self._clear_cached_op()
+
+    def infer_shape(self, *args):
+        """Run a deferred-shape probe (reference: block.py infer_shape)."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        """Resolve 0-dims in child parameters by running the imperative
+        forward once with recording off (the reference walks the symbolic
+        graph; a concrete probe is equivalent and simpler here)."""
+        with autograd.pause(train_mode=False):
+            self.forward(*args)
+
+    def __call__(self, *args):
+        if self._active:
+            return self._call_cached_op(*args)
+        return self.forward(*args)
+
+    # --------------------------------------------------- CachedOp (jit)
+    def _make_cached_op(self, flat_args):
+        params = [p for _, p in sorted(self.collect_params().items())]
+        # non-differentiable params (BatchNorm running stats) follow the
+        # aux-state protocol: the traced program returns their updated
+        # values as extra outputs to commit after the call
+        aux_idx = [i for i, p in enumerate(params) if p.grad_req == "null"]
+        n_in = len(flat_args)
+        out_fmt = {}   # filled at trace time
+
+        def raw(*vals):
+            in_vals = vals[:n_in]
+            p_vals = vals[n_in:]
+            wrapped = [NDArray(v) for v in in_vals]
+            for p, v in zip(params, p_vals):
+                p._data_override = NDArray(v)
+            try:
+                with autograd.pause(train_mode=autograd.is_training()):
+                    out = self.forward(*wrapped)
+                aux_new = tuple(params[i]._data_override._data
+                                for i in aux_idx)
+            finally:
+                for p in params:
+                    p._data_override = None
+            flat_out, fmt = _flatten(out)
+            out_fmt["fmt"] = fmt
+            out_fmt["n_out"] = len(flat_out)
+            return tuple(o._data for o in flat_out) + aux_new
+
+        jitted = jax.jit(raw)
+        op = OpDef("_cached_op_%s" % self.name, jitted, num_inputs=None)
+        return jitted, op, params, aux_idx, out_fmt
+
+    def _call_cached_op(self, *args):
+        flat_args, _ = _flatten(args)
+        try:
+            if any(isinstance(a._data, jax.core.Tracer)
+                   for a in flat_args):
+                # inside an enclosing trace (parent CachedOp): run the
+                # imperative body so the parent's jit sees the whole graph
+                return self.forward(*args)
+            sig = tuple((tuple(a.shape), str(a.dtype)) for a in flat_args) \
+                + (autograd.is_training(),)
+        except AttributeError:
+            return self.forward(*args)  # non-NDArray inputs: eager
+        entry = self._cached_op.get(sig) if self._cached_op else None
+        if entry is None:
+            # materialize deferred params before tracing (probe if needed)
+            if any(p._data is None
+                   for p in self.collect_params().values()):
+                self._deferred_infer_shape(*args)
+            for _, p in sorted(self.collect_params().items()):
+                p._finish_deferred_init()
+            entry = self._make_cached_op(flat_args)
+            if self._cached_op is None:
+                self._cached_op = {}
+            self._cached_op[sig] = entry
+        jitted, op, params, aux_idx, out_fmt = entry
+
+        in_nds = list(flat_args) + [p.data() for p in params]
+        in_vals = [a._data for a in in_nds]
+        all_outs = jitted(*in_vals)
+        n_out = out_fmt["n_out"]
+        out_nds = [NDArray(o) for o in all_outs[:n_out]]
+        # commit updated aux states (BatchNorm moving stats)
+        aux_targets = []
+        for i, v in zip(aux_idx, all_outs[n_out:]):
+            arr = params[i]._data
+            arr._data = v
+            arr._version += 1
+            aux_targets.append(arr)
+        if autograd.is_recording():
+            # record the compiled forward as ONE composite tape op: backward
+            # is one jax.vjp over the jitted program (CachedOp backward)
+            in_keys = [(a._uid, a._version) for a in in_nds]
+            autograd._record_op(op, {}, in_keys, in_vals,
+                                out_nds + aux_targets)
+        fmt = out_fmt.get("fmt", 0)
+        if fmt == 0:
+            return out_nds[0]
+        res, _ = _regroup(out_nds, fmt)
+        return res
+
+    # --------------------------------------------------- imperative path
+    def forward(self, x, *args):
+        """Gather params and defer to hybrid_forward (reference:
+        block.py HybridBlock.forward)."""
+        try:
+            params = {k: p._active_data()
+                      for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._infer_param_shapes(x, *args)
+            params = {k: p._active_data()
+                      for k, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def _infer_param_shapes(self, x, *args):
+        """Resolve deferred shapes from the first input (layers override
+        shape hooks via their own logic in hybrid_forward pre-checks)."""
+        self.shape_update(x, *args)
+        for p in self._reg_params.values():
+            p._finish_deferred_init()
+
+    def shape_update(self, x, *args):
+        """Layers with deferred params override to set shapes from input."""
+        raise DeferredInitializationError(
+            "%s has uninitialized parameters and does not implement "
+            "shape inference" % type(self).__name__)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path):
+        """Export symbol json + params for the predict path (reference:
+        block.py export via HybridBlock symbols). Uses the symbolic twin of
+        hybrid_forward."""
+        raise NotImplementedError(
+            "export requires the symbolic tracing frontend; use "
+            "mx.mod.Module checkpoints for deployment")
+
+
+def _param_active_data(self):
+    override = getattr(self, "_data_override", None)
+    if override is not None:
+        return override
+    if self._data is None:
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter %s pending deferred init" % self.name)
+        self._check_initialized()
+    return self._data
+
+
+# attach the trace-override accessor used by the CachedOp path
+Parameter._active_data = _param_active_data
+Parameter._data_override = None
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol into a Block (reference: block.py:433)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from .. import symbol as sym_mod
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        self._in_names = [i.name for i in inputs]
+        self._symbol = outputs
+        arg_names = set(outputs.list_arguments())
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names - set(self._in_names):
+            self.params.get(name[len(self.params.prefix):]
+                            if name.startswith(self.params.prefix) else name,
+                            allow_deferred_init=True)
+        for name in aux_names:
+            self.params.get(name[len(self.params.prefix):]
+                            if name.startswith(self.params.prefix) else name,
+                            grad_req="null", allow_deferred_init=True)
+        self._fn = None
+        self._op = None
+
+    def forward(self, *args):
+        from ..executor import graph_function
+        from .. import random as rnd_mod
+        if self._fn is None:
+            gfn = graph_function(self._symbol)
+            arg_names = [n for n in self._symbol.list_arguments()]
+            aux_names = list(self._symbol.list_auxiliary_states())
+            in_order = self._in_names + \
+                [n for n in arg_names if n not in self._in_names]
+
+            def positional(*vals):
+                n_args = len(in_order)
+                arg_map = dict(zip(in_order, vals[:n_args]))
+                aux_map = dict(zip(aux_names, vals[n_args:-1]))
+                key = vals[-1]
+                outs, _ = gfn(arg_map, aux_map, key,
+                              autograd.is_training())
+                return tuple(outs)
+
+            self._fn = positional
+            self._in_order = in_order
+            self._aux_names = aux_names
+            self._op = OpDef("_symbol_block_%s" % self.name, positional,
+                             num_inputs=None, is_random=False)
+
+        named = dict(zip(self._in_names, args))
+        in_nds = []
+        for n in self._in_order:
+            if n in named:
+                a = named[n]
+                in_nds.append(a if isinstance(a, NDArray) else NDArray(a))
+            else:
+                in_nds.append(self.params[n]._active_data())
+        in_nds += [self.params[n]._active_data() for n in self._aux_names]
+        key_nd = NDArray(rnd_mod.next_key())
+        in_nds.append(key_nd)
+        in_vals = [a._data for a in in_nds]
+        outs = self._fn(*in_vals)
+        out_nds = [NDArray(o) for o in outs]
+        if autograd.is_recording():
+            in_keys = [(a._uid, a._version) for a in in_nds]
+            autograd._record_op(self._op, {}, in_keys, in_vals, out_nds)
+        return out_nds[0] if len(out_nds) == 1 else out_nds

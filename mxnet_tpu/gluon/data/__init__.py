@@ -1,0 +1,11 @@
+"""Gluon data API (reference: python/mxnet/gluon/data/)."""
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+from . import vision
+
+from . import dataset
+from . import sampler
+from . import dataloader
+
+__all__ = dataset.__all__ + sampler.__all__ + dataloader.__all__ + ["vision"]

@@ -1,0 +1,94 @@
+"""DataLoader.
+
+Reference: ``python/mxnet/gluon/data/dataloader.py`` — batches a Dataset
+with a Sampler. The reference's multiprocessing workers are replaced by an
+optional background-thread prefetcher (the TPU host pipeline is
+IO/decode-bound, and the heavy decode path lives in the C++/threaded
+RecordIO iterators — SURVEY.md §2.8).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ... import ndarray as nd
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py
+    default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader(object):
+    """(reference: dataloader.py DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch_idx in self._batch_sampler:
+                yield self._make_batch(batch_idx)
+            return
+
+        # double-buffered background prefetch (dmlc::ThreadedIter analogue,
+        # reference: src/io/iter_prefetcher.h:46)
+        q: "queue.Queue" = queue.Queue(maxsize=max(2, self._num_workers))
+        sentinel = object()
+
+        def worker():
+            try:
+                for batch_idx in self._batch_sampler:
+                    q.put(self._make_batch(batch_idx))
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+
+    def __len__(self):
+        return len(self._batch_sampler)

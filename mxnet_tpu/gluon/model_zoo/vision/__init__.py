@@ -1,0 +1,46 @@
+"""Vision model zoo.
+
+Reference: ``python/mxnet/gluon/model_zoo/vision/`` — alexnet, densenet,
+inception-v3, resnet v1/v2 (18-152), squeezenet, vgg 11-19 (+bn), mobilenet.
+``pretrained=True`` is not supported here (no-egress environment; the
+reference downloads from its model store).
+"""
+from .alexnet import *
+from .densenet import *
+from .inception import *
+from .resnet import *
+from .squeezenet import *
+from .vgg import *
+from .mobilenet import *
+
+_models = {}
+
+
+def _register_models():
+    import importlib
+    mods = [importlib.import_module(__name__ + "." + m)
+            for m in ("alexnet", "densenet", "inception", "resnet",
+                      "squeezenet", "vgg", "mobilenet")]
+    for mod in mods:
+        for name in mod.__all__:
+            fn = getattr(mod, name)
+            if callable(fn) and not name[0].isupper() and \
+                    not name.startswith("get_"):
+                _models[name] = fn
+
+
+_register_models()
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (reference: model_zoo/__init__.py
+    get_model)."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            "Model %s is not supported. Available: %s"
+            % (name, sorted(_models.keys())))
+    return _models[name](**kwargs)
+
+
+__all__ = ["get_model"] + sorted(_models.keys())

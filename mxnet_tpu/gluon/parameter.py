@@ -1,0 +1,339 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: ``python/mxnet/gluon/parameter.py`` (611 LoC) — ``Parameter``
+holds data+grad per context with deferred initialization; ``ParameterDict``
+is a prefix-scoped registry shared across blocks.
+
+TPU note: one ``jax.Array`` (possibly mesh-sharded) replaces the reference's
+per-device copy list, so ``list_data``/``list_grad`` return single-element
+lists unless multiple contexts were requested explicitly.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from .. import initializer as init_mod
+from ..initializer import InitDesc
+
+__all__ = ["Parameter", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape was known (reference:
+    parameter.py DeferredInitializationError)."""
+
+
+class Parameter(object):
+    """A Block parameter (reference: parameter.py Parameter).
+
+    Holds the value and gradient; supports deferred initialization for
+    shapes with unknown (0) dimensions resolved at first forward.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        self._data: Optional[nd.NDArray] = None
+        self._grad: Optional[nd.NDArray] = None
+        self._deferred_init = ()  # (init, ctx, default_init)
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self.shape, np.dtype(self.dtype).name)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+        elif self._data is not None and self._grad is None:
+            self._init_grad()
+
+    # ------------------------------------------------------------- init
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """(reference: parameter.py Parameter.initialize)."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter %s because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        self._deferred_init = ()
+        if self.shape is None or any(s == 0 for s in self.shape):
+            raise DeferredInitializationError(
+                "deferred init of %s failed: shape still unknown (%s)"
+                % (self.name, self.shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
+        initializer = init if init is not None else \
+            (self.init if self.init is not None else default_init)
+        initializer(InitDesc(self.name, {"__init__": ""}), data)
+        self._data = data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = nd.zeros(self.shape, dtype=self.dtype,
+                              ctx=self._data.context)
+        from .. import autograd
+        autograd.mark_variables([self._data], [self._grad],
+                                grad_reqs=self._grad_req)
+
+    def _load_init(self, data, ctx=None):
+        """Load from a checkpoint value (reference: parameter.py
+        _load_init)."""
+        if self.shape is not None and not any(s == 0 for s in self.shape):
+            if tuple(data.shape) != tuple(self.shape):
+                raise ValueError(
+                    "Failed loading Parameter %s from saved params: shape "
+                    "mismatch %s vs %s" % (self.name, data.shape, self.shape))
+        self.shape = tuple(data.shape)
+        self._deferred_init = ()
+        self._data = data.astype(self.dtype) \
+            if np.dtype(data.dtype) != np.dtype(self.dtype) else data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    # ------------------------------------------------------------- access
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass." % self.name)
+        raise RuntimeError(
+            "Parameter %s has not been initialized. You should initialize "
+            "parameters with Block.collect_params().initialize(...)"
+            % self.name)
+
+    def data(self, ctx=None) -> nd.NDArray:
+        """(reference: parameter.py Parameter.data)."""
+        self._check_initialized()
+        return self._data
+
+    def list_data(self) -> List[nd.NDArray]:
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None) -> nd.NDArray:
+        self._check_initialized()
+        if self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because "
+                "grad_req='null'" % self.name)
+        return self._grad
+
+    def list_grad(self) -> List[nd.NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self) -> List[Context]:
+        self._check_initialized()
+        return [self._data.context]
+
+    def set_data(self, data):
+        """(reference: parameter.py set_data)."""
+        self._check_initialized()
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        self._data[:] = data
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def var(self):
+        """Symbol variable for this parameter (reference: parameter.py
+        var)."""
+        from .. import symbol as sym
+        return sym.Variable(self.name, shape=self.shape,
+                            lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                from .. import autograd
+                autograd.mark_variables([self._data], [self._grad],
+                                        grad_reqs=self._grad_req)
+
+    def reset_ctx(self, ctx):
+        """Move to a new context (reference: parameter.py reset_ctx)."""
+        if self._data is not None:
+            self._data = self._data.copyto(ctx if isinstance(ctx, Context)
+                                           else ctx[0])
+            if self._grad_req != "null":
+                self._init_grad()
+
+
+class ParameterDict(object):
+    """A prefix-scoped dict of Parameters (reference: parameter.py:
+    ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "%s(\n%s\n)" % (self._prefix or "ParameterDict",
+                            "\n".join("  " + repr(p)
+                                      for p in self._params.values()))
+        return s
+
+    def __getitem__(self, key) -> Parameter:
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Create-or-retrieve ``self.prefix + name`` (reference:
+        parameter.py ParameterDict.get)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if getattr(param, k, None) is not None and v is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and len(v) == len(existing):
+                        # merge unknown dims
+                        merged = tuple(a if a != 0 else b
+                                       for a, b in zip(v, existing))
+                        param.shape = merged
+                        continue
+                    assert str(existing) == str(v) or k in ("init",), \
+                        "Parameter %s already exists with different %s" \
+                        % (name, k)
+                else:
+                    setattr(param, k if k != "grad_req" else "_grad_req", v)
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update because keys have different values"
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """(reference: parameter.py ParameterDict.initialize)."""
+        if init is None:
+            init = init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        """(reference: parameter.py ParameterDict.save)."""
+        arg_dict = {}
+        for param in self.values():
+            block = param.list_data()
+            weight = sum(w.copyto(cpu()) for w in block) / len(block)
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix %s is to be stripped before saving, but "
+                    "Parameter %s does not start with %s"
+                    % (strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        """(reference: parameter.py ParameterDict.load)."""
+        arg_dict = nd.load(filename)
+        if restore_prefix:
+            arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter %s is missing in file %s" % (name, filename)
+        for name, value in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise ValueError(
+                        "Parameter %s loaded from file %s is not present in "
+                        "ParameterDict" % (name, filename))
+                continue
+            self[name]._load_init(value, ctx)

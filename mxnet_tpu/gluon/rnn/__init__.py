@@ -1,0 +1,8 @@
+"""Gluon RNN API (reference: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *
+from .rnn_layer import *
+
+from . import rnn_cell
+from . import rnn_layer
+
+__all__ = rnn_cell.__all__ + rnn_layer.__all__

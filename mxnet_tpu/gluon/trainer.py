@@ -1,0 +1,120 @@
+"""Gluon Trainer.
+
+Reference: ``python/mxnet/gluon/trainer.py`` — ``Trainer`` (line 26) applies
+an Optimizer to a ParameterDict; ``step`` (line 116) pushes grads / pulls
+weights through the KVStore per parameter.
+
+TPU note: with one (possibly mesh-replicated) jax.Array per parameter there
+is nothing to aggregate on a single host — step() applies the updater
+directly; a ``dist`` kvstore routes through push/pull for API parity.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..model import _create_kvstore
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer(object):
+    """(reference: trainer.py:26)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device"):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % type(params))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % type(param))
+            if param.grad_req != "null":
+                self._params.append(param)
+
+        self._scale = 1.0
+        optimizer_params = dict(optimizer_params or {})
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore_arg = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer, **optimizer_params)
+        self._optimizer.idx2name = {i: p.name
+                                    for i, p in enumerate(self._params)}
+        self._optimizer.lr_mult = {p.name: p.lr_mult for p in self._params}
+        self._optimizer.wd_mult = {p.name: p.wd_mult for p in self._params}
+        self._updaters = opt.get_updater(self._optimizer)
+
+    def _init_kvstore(self):
+        arg_arrays = {p.name: p.data() for p in self._params}
+        kvstore, update_on_kvstore = _create_kvstore(
+            self._kvstore_arg, 1, arg_arrays)
+        if kvstore:
+            for i, param in enumerate(self._params):
+                kvstore.init(i, param.data())
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        """(reference: trainer.py set_learning_rate)."""
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimizer step with grads rescaled by 1/batch_size
+        (reference: trainer.py:116)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._kvstore is not None:
+                grad = param.grad()
+                self._kvstore.push(i, grad)
+                if self._update_on_kvstore:
+                    self._kvstore.pull(i, out=param.data())
+                    continue
+                self._kvstore.pull(i, out=grad)
+            self._updaters(i, param.grad(), param.data())
+
+    def save_states(self, fname):
+        """(reference: trainer.py save_states)."""
+        assert self._optimizer is not None
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters.get_states())
+
+    def load_states(self, fname):
+        """(reference: trainer.py load_states)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as fin:
+                self._updaters.set_states(fin.read())

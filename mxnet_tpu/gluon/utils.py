@@ -1,0 +1,75 @@
+"""Gluon utilities.
+
+Reference: ``python/mxnet/gluon/utils.py`` — split_data / split_and_load
+(manual batch slicing for multi-device) and clip_global_norm.
+
+TPU note: split_and_load can instead shard one array over a mesh when given
+several contexts — one logical array, XLA moves the shards.
+"""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..context import Context
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice slices (reference:
+    utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            "Too many slices for data with shape %s. Arguments are "
+            "num_slice=%d and batch_axis=%d." % (data.shape, num_slice,
+                                                 batch_axis))
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data."
+            % (data.shape, num_slice, batch_axis, num_slice))
+
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        if batch_axis == 0:
+            slices.append(data[begin:end])
+        else:
+            slices.append(nd.slice_axis(data, axis=batch_axis,
+                                        begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and load to each context (reference: utils.py
+    split_and_load)."""
+    if not isinstance(data, nd.NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so the sum of their 2-norms is <= max_norm
+    (reference: utils.py clip_global_norm)."""
+    assert len(arrays) > 0
+    total_norm = 0.0
+    for arr in arrays:
+        norm = float(nd.sum(arr * arr).asscalar())
+        total_norm += norm
+    total_norm = math.sqrt(total_norm)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm

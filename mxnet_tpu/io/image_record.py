@@ -19,7 +19,30 @@ from .. import ndarray as nd
 from ..recordio import MXRecordIO, MXIndexedRecordIO, unpack
 from .io import DataBatch, DataDesc, DataIter
 
-__all__ = ["ImageRecordIter", "ImageRecordUInt8Iter"]
+__all__ = ["ImageRecordIter", "ImageRecordUInt8Iter", "imdecode", "imread"]
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an encoded image buffer to an HWC uint8 NDArray (reference:
+    src/io/image_io.cc imdecode — same (buf, flag, to_rgb) order)."""
+    import cv2
+    arr = np.frombuffer(buf, dtype=np.uint8) \
+        if isinstance(buf, (bytes, bytearray)) else np.asarray(buf, np.uint8)
+    img = cv2.imdecode(arr, cv2.IMREAD_COLOR if flag else
+                       cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise ValueError("imdecode: cannot decode buffer")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype=np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read + decode an image file (reference: plugin/opencv cv_api.cc)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
 
 
 class ImageRecordIter(DataIter):

@@ -1,0 +1,14 @@
+"""Symbol-level model definitions (the capability of
+``example/image-classification/symbols/`` in the reference, SURVEY.md §2.15).
+
+These build ``mx.sym`` graphs consumed by ``mx.mod.Module``; the Gluon model
+zoo (``mxnet_tpu/gluon/model_zoo``) is the imperative twin.
+"""
+from . import resnet
+from . import mlp
+from . import lenet
+from . import alexnet
+from . import vgg
+from .resnet import get_symbol as get_resnet
+
+__all__ = ["resnet", "mlp", "lenet", "alexnet", "vgg", "get_resnet"]

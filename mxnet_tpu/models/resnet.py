@@ -1,0 +1,150 @@
+"""ResNet v1/v2 symbol builder.
+
+Capability twin of ``example/image-classification/symbols/resnet.py`` in the
+reference (He et al. 2015/2016, pre-activation variant for v2). Built fresh
+for TPU: NCHW layout, bf16-friendly (convs accumulate fp32 on the MXU
+regardless of input dtype), BatchNorm with aux moving stats.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+__all__ = ["get_symbol", "resnet"]
+
+# depth -> (block counts per stage, bottleneck?)
+_CONFIGS = {
+    18: ([2, 2, 2, 2], False),
+    34: ([3, 4, 6, 3], False),
+    50: ([3, 4, 6, 3], True),
+    101: ([3, 4, 23, 3], True),
+    152: ([3, 8, 36, 3], True),
+}
+
+
+def _conv(data, num_filter, kernel, stride, pad, name):
+    return sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
+                           stride=stride, pad=pad, no_bias=True, name=name)
+
+
+def _bn(data, name, fix_gamma=False):
+    return sym.BatchNorm(data=data, fix_gamma=fix_gamma, eps=2e-5,
+                         momentum=0.9, name=name)
+
+
+def _unit_v1(data, num_filter, stride, dim_match, name, bottleneck):
+    """Post-activation residual unit (v1)."""
+    if bottleneck:
+        b = _conv(data, num_filter // 4, (1, 1), stride, (0, 0), name + "_conv1")
+        b = _bn(b, name + "_bn1")
+        b = sym.Activation(data=b, act_type="relu", name=name + "_relu1")
+        b = _conv(b, num_filter // 4, (3, 3), (1, 1), (1, 1), name + "_conv2")
+        b = _bn(b, name + "_bn2")
+        b = sym.Activation(data=b, act_type="relu", name=name + "_relu2")
+        b = _conv(b, num_filter, (1, 1), (1, 1), (0, 0), name + "_conv3")
+        b = _bn(b, name + "_bn3")
+    else:
+        b = _conv(data, num_filter, (3, 3), stride, (1, 1), name + "_conv1")
+        b = _bn(b, name + "_bn1")
+        b = sym.Activation(data=b, act_type="relu", name=name + "_relu1")
+        b = _conv(b, num_filter, (3, 3), (1, 1), (1, 1), name + "_conv2")
+        b = _bn(b, name + "_bn2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv(data, num_filter, (1, 1), stride, (0, 0),
+                         name + "_sc")
+        shortcut = _bn(shortcut, name + "_sc_bn")
+    out = b + shortcut
+    return sym.Activation(data=out, act_type="relu", name=name + "_relu")
+
+
+def _unit_v2(data, num_filter, stride, dim_match, name, bottleneck):
+    """Pre-activation residual unit (v2 — the reference's default)."""
+    bn1 = _bn(data, name + "_bn1")
+    act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
+    if bottleneck:
+        b = _conv(act1, num_filter // 4, (1, 1), (1, 1), (0, 0),
+                  name + "_conv1")
+        b = _bn(b, name + "_bn2")
+        b = sym.Activation(data=b, act_type="relu", name=name + "_relu2")
+        b = _conv(b, num_filter // 4, (3, 3), stride, (1, 1), name + "_conv2")
+        b = _bn(b, name + "_bn3")
+        b = sym.Activation(data=b, act_type="relu", name=name + "_relu3")
+        b = _conv(b, num_filter, (1, 1), (1, 1), (0, 0), name + "_conv3")
+    else:
+        b = _conv(act1, num_filter, (3, 3), stride, (1, 1), name + "_conv1")
+        b = _bn(b, name + "_bn2")
+        b = sym.Activation(data=b, act_type="relu", name=name + "_relu2")
+        b = _conv(b, num_filter, (3, 3), (1, 1), (1, 1), name + "_conv2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv(act1, num_filter, (1, 1), stride, (0, 0),
+                         name + "_sc")
+    return b + shortcut
+
+
+def resnet(units, num_stages, filter_list, num_classes, image_shape,
+           bottleneck=True, version=2):
+    """Assemble a ResNet (reference: symbols/resnet.py resnet())."""
+    data = sym.Variable("data")
+    nchannel, height, _ = image_shape
+    unit = _unit_v2 if version == 2 else _unit_v1
+
+    body = data
+    if version == 2:
+        body = _bn(body, "bn_data", fix_gamma=True)
+    if height <= 32:  # cifar-style stem
+        body = _conv(body, filter_list[0], (3, 3), (1, 1), (1, 1), "conv0")
+    else:             # imagenet stem
+        body = _conv(body, filter_list[0], (7, 7), (2, 2), (3, 3), "conv0")
+        body = _bn(body, "bn0")
+        body = sym.Activation(data=body, act_type="relu", name="relu0")
+        body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                           pad=(1, 1), pool_type="max", name="pool0")
+
+    for i in range(num_stages):
+        stride = (1, 1) if i == 0 and height > 32 else \
+            ((1, 1) if i == 0 else (2, 2))
+        body = unit(body, filter_list[i + 1], stride, False,
+                    "stage%d_unit1" % (i + 1), bottleneck)
+        for j in range(units[i] - 1):
+            body = unit(body, filter_list[i + 1], (1, 1), True,
+                        "stage%d_unit%d" % (i + 1, j + 2), bottleneck)
+
+    if version == 2:
+        body = _bn(body, "bn1")
+        body = sym.Activation(data=body, act_type="relu", name="relu1")
+    pool = sym.Pooling(data=body, global_pool=True, kernel=(7, 7),
+                       pool_type="avg", name="pool1")
+    flat = sym.Flatten(data=pool)
+    fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(data=fc1, name="softmax")
+
+
+def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
+               version=2, **kwargs):
+    """(reference: symbols/resnet.py get_symbol)."""
+    if isinstance(image_shape, str):
+        image_shape = tuple(int(x) for x in image_shape.split(","))
+    if num_layers not in _CONFIGS:
+        raise ValueError("unsupported resnet depth %d" % num_layers)
+    units, bottleneck = _CONFIGS[num_layers]
+    if image_shape[1] <= 32:
+        # cifar config (reference resnet.py: per-depth unit derivation)
+        if (num_layers - 2) % 9 == 0 and num_layers >= 164:
+            per = (num_layers - 2) // 9
+            units, bottleneck = [per] * 3, True
+        elif (num_layers - 2) % 6 == 0:
+            per = (num_layers - 2) // 6
+            units, bottleneck = [per] * 3, False
+        filter_list = [16, 64, 128, 256] if bottleneck else [16, 16, 32, 64]
+        num_stages = 3
+    else:
+        filter_list = [64, 256, 512, 1024, 2048] if bottleneck else \
+            [64, 64, 128, 256, 512]
+        num_stages = 4
+    return resnet(units=units[:num_stages], num_stages=num_stages,
+                  filter_list=filter_list, num_classes=num_classes,
+                  image_shape=image_shape, bottleneck=bottleneck,
+                  version=version)

@@ -92,6 +92,7 @@ class Module(BaseModule):
         self._fused = None          # jitted fused train step
         self._fused_out = None      # outputs of the last fused step
         self._fused_states = None   # optimizer-state pytree for fused path
+        self._fused_num_update = 0
 
     # ------------------------------------------------------------- loading
     @staticmethod
@@ -288,7 +289,13 @@ class Module(BaseModule):
         kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), arg_params)
 
-        batch_size = sum(d.shape[0] for d in self._data_shapes) or 1
+        # all data inputs share ONE batch size (reference:
+        # executor_group.decide_slices asserts this; never summed)
+        batch_sizes = {d.shape[0] for d in self._data_shapes if d.shape}
+        if len(batch_sizes) > 1:
+            raise MXNetError("data inputs disagree on batch size: %s"
+                             % [(d.name, d.shape) for d in self._data_shapes])
+        batch_size = batch_sizes.pop() if batch_sizes else 1
         if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
             batch_size *= kvstore.num_workers
         rescale_grad = 1.0 / batch_size

@@ -137,8 +137,10 @@ class SequentialModule(BaseModule):
             if meta.get(SequentialModule.META_AUTO_WIRING, False):
                 data_names = module.data_names
                 assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
+                my_data_shapes = [
+                    (new_name,
+                     d.shape if isinstance(d, DataDesc) else d[1])
+                    for new_name, d in zip(data_names, my_data_shapes)]
 
             module.bind(data_shapes=my_data_shapes,
                         label_shapes=my_label_shapes,

@@ -43,7 +43,11 @@ def _ctx_of(data: jax.Array) -> Context:
     try:
         dev = data.device
     except Exception:
-        dev = list(data.devices())[0]
+        dev = None
+    if not isinstance(dev, jax.Device):
+        # multi-device (sharded/replicated) array: .device is a Sharding —
+        # report the first component device's context
+        dev = sorted(data.devices(), key=lambda d: d.id)[0]
     kind = "cpu" if dev.platform == "cpu" else "tpu"
     return Context(kind, dev.id)
 
@@ -215,6 +219,11 @@ class NDArray:
 
     # ------------------------------------------------------- arithmetic
     def _binop(self, other, opname, scalar_opname, reverse=False):
+        if isinstance(other, jax.Array):
+            # jax value (possibly a tracer, e.g. a traced lr inside the fused
+            # train step): can't concretize to float — go through the
+            # broadcasting elementwise op instead of the *_scalar op
+            other = NDArray(other)
         if isinstance(other, NDArray):
             a, b = (other, self) if reverse else (self, other)
             return imperative_invoke(get_op(opname), a, b)

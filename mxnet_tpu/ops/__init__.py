@@ -15,5 +15,6 @@ from . import nn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import contrib  # noqa: F401
 from . import optimizer_op  # noqa: F401
+from . import rnn_op  # noqa: F401
 
 __all__ = ["OpDef", "OP_REGISTRY", "register", "alias", "get_op", "list_ops"]

@@ -241,13 +241,15 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
                 hi += stride[i] - rem
         pads.append((lo, hi))
     if pool_type == "max":
+        # init must be a host constant, not a jnp array: reduce_window's
+        # autodiff rule can't linearize a traced init value
         if jnp.issubdtype(data.dtype, jnp.floating):
-            init = jnp.array(-jnp.inf, data.dtype)
+            init = np.array(-np.inf, data.dtype)
         else:
-            init = jnp.array(jnp.iinfo(data.dtype).min, data.dtype)
+            init = np.array(jnp.iinfo(data.dtype).min, data.dtype)
         out = lax.reduce_window(data, init, lax.max, window, strides, pads)
     elif pool_type in ("avg", "sum"):
-        zero = jnp.zeros((), data.dtype)
+        zero = np.zeros((), data.dtype)
         out = lax.reduce_window(data, zero, lax.add, window, strides, pads)
         if pool_type == "avg":
             if count_include_pad:

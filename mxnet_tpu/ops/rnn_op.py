@@ -1,0 +1,178 @@
+"""Fused RNN operator (RNN/LSTM/GRU, multi-layer, bidirectional).
+
+Reference: ``src/operator/rnn-inl.h`` + ``cudnn_rnn-inl.h`` — the reference's
+fused RNN is cuDNN-only (CPU path is LOG(FATAL), rnn.cc:31-32); cells had to
+be unrolled on CPU. Here the fused path is first-class on every backend:
+each layer is one ``lax.scan`` whose step does a single gate matmul on the
+MXU — the idiomatic TPU shape for recurrence (no dynamic control flow,
+static shapes, weights resident in registers/HBM across steps).
+
+Parameter packing (cuDNN convention, matching FusedRNNCell.unfuse order):
+for each layer, for each direction: W_x (G*H, in), W_h (G*H, H); then for
+each layer/direction: b_x (G*H), b_h (G*H). Gate order: LSTM i,f,g,o;
+GRU r,z,n (cuDNN).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["rnn_param_size", "rnn_unpack_params"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, mode,
+                   bidirectional=False):
+    """Total packed parameter count (reference: rnn-inl.h GetParamSize)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        size += dirs * gates * state_size * (in_sz + state_size + 2)
+    return size
+
+
+def rnn_unpack_params(params, num_layers, input_size, state_size, mode,
+                      bidirectional=False):
+    """Split the packed vector into per-layer/direction (Wx, Wh, bx, bh)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    G = gates * state_size
+    weights, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            wx = params[off:off + G * in_sz].reshape(G, in_sz)
+            off += G * in_sz
+            wh = params[off:off + G * state_size].reshape(G, state_size)
+            off += G * state_size
+            weights.append((wx, wh))
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            bx = params[off:off + G]
+            off += G
+            bh = params[off:off + G]
+            off += G
+            biases.append((bx, bh))
+    return weights, biases
+
+
+def _cell_step(mode, H):
+    """One time step: (h[, c]), gates -> new state and output h."""
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(h, c, pre):
+            h_new = act(pre)
+            return h_new, None, h_new
+    elif mode == "lstm":
+        def step(h, c, pre):
+            i, f, g, o = jnp.split(pre, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new, h_new
+    elif mode == "gru":
+        # GRU needs the recurrent term per-gate (n gate uses r*(Wh h)):
+        # handled in _scan_layer by passing both x-side and h-side pre-acts
+        def step(h, c, pre):
+            raise NotImplementedError
+    else:
+        raise ValueError("unknown RNN mode %r" % mode)
+    return step
+
+
+def _scan_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
+    """Run one direction of one layer over time. x: (T, N, in)."""
+    H = h0.shape[-1]
+    # hoist the input projection out of the scan: one big MXU matmul
+    x_proj = jnp.einsum("tni,gi->tng", x, wx,
+                        preferred_element_type=jnp.float32).astype(x.dtype) \
+        + (bx + (0.0 if mode == "gru" else bh)).astype(x.dtype)
+
+    if mode == "gru":
+        def body(carry, xp):
+            h = carry
+            rp = h @ wh.T + bh.astype(h.dtype)   # recurrent pre-activation
+            xr, xz, xn = jnp.split(xp, 3, axis=-1)
+            hr, hz, hn = jnp.split(rp, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return h_new, h_new
+
+        h_last, ys = lax.scan(body, h0, x_proj, reverse=reverse)
+        return ys, h_last, None
+
+    step = _cell_step(mode, H)
+
+    def body(carry, xp):
+        h, c = carry
+        pre = xp + h @ wh.T
+        if mode != "lstm":
+            pre = pre  # bh already folded into x_proj
+        h_new, c_new, y = step(h, c, pre)
+        return (h_new, c_new if c_new is not None else c), y
+
+    if mode == "lstm":
+        init = (h0, c0 if c0 is not None else jnp.zeros_like(h0))
+    else:
+        init = (h0, jnp.zeros_like(h0))
+    (h_last, c_last), ys = lax.scan(body, init, x_proj, reverse=reverse)
+    return ys, h_last, (c_last if mode == "lstm" else None)
+
+
+@register("RNN", num_inputs=None, aliases=("rnn",), needs_rng=True)
+def rnn(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, _is_train=False, _rng=None):
+    """Fused multi-layer RNN (reference: src/operator/rnn-inl.h RNNOp).
+
+    data: (T, N, input_size); state: (L*dirs, N, H); returns output
+    (T, N, H*dirs) and, with ``state_outputs``, final states.
+    """
+    T, N, input_size = data.shape
+    H = int(state_size)
+    L = int(num_layers)
+    dirs = 2 if bidirectional else 1
+    weights, biases = rnn_unpack_params(parameters, L, input_size, H, mode,
+                                        bidirectional)
+
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            wx, wh = weights[idx]
+            bx, bh = biases[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            ys, h_last, c_last = _scan_layer(
+                x, h0, c0, wx, wh, bx, bh, mode, reverse=(d == 1))
+            outs.append(ys)
+            h_finals.append(h_last)
+            if c_last is not None:
+                c_finals.append(c_last)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and _is_train and layer < L - 1 and _rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(_rng, layer), 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+    if not state_outputs:
+        return x
+    h_out = jnp.stack(h_finals)
+    if mode == "lstm":
+        return x, h_out, jnp.stack(c_finals)
+    return x, h_out

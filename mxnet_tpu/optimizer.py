@@ -158,15 +158,17 @@ class Optimizer(object):
 
         w, g, s = NDArray(weight), NDArray(grad), wrap(state)
         self._traced_lr, self._traced_t = lr, t
-        saved_counts = dict(self._index_update_count)
-        saved_num_update = self.num_update
+        # snapshot ALL instance attrs: a traced update() must not leak
+        # tracers into persistent optimizer state (state flows through the
+        # returned pytree instead)
+        saved = {k: (dict(v) if isinstance(v, dict) else v)
+                 for k, v in self.__dict__.items()}
         try:
             self.update(index, w, g, s)
         finally:
-            # don't leak traced scalars into persistent optimizer state
+            self.__dict__.clear()
+            self.__dict__.update(saved)
             self._traced_lr = self._traced_t = None
-            self._index_update_count = saved_counts
-            self.num_update = saved_num_update
         return w._data, unwrap(s)
 
     def _common_kwargs(self, index):
@@ -321,7 +323,8 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        # ** 0.5, not math.sqrt: t may be a traced scalar on the fused path
+        lr *= coef2 ** 0.5 / coef1
         mean, var = state
         _invoke("adam_update", [weight, grad, mean, var], [weight, mean, var],
                 lr=lr, beta1=self.beta1, beta2=self.beta2,
@@ -458,11 +461,14 @@ class Nadam(Optimizer):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
 
     def create_state(self, index, weight):
+        # the cumulative momentum schedule lives in per-param state (not on
+        # the instance, unlike the reference) so the traced fused-update path
+        # threads it functionally across steps
         return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
-                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.ones((1,), dtype=np.float32, ctx=weight.context))
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -474,18 +480,20 @@ class Nadam(Optimizer):
         momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
         momentum_t_1 = self.beta1 * (
             1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
-        self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t_1
-        mean, var = state
+        mean, var, msch = state
+        m_schedule = msch * momentum_t
+        m_schedule_next = m_schedule * momentum_t_1
         mean._data = (self.beta1 * mean + (1.0 - self.beta1) * g).data
         var._data = (self.beta2 * var + (1.0 - self.beta2) * g * g).data
         mean._version += 1
         var._version += 1
-        g_prime = g / (1.0 - self.m_schedule)
+        g_prime = g / (1.0 - m_schedule)
         m_t_prime = mean / (1.0 - m_schedule_next)
         v_t_prime = var / (1.0 - self.beta2 ** t)
         m_t_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_t_prime
         weight -= lr * m_t_bar / (nd.sqrt(v_t_prime) + self.epsilon)
+        msch._data = m_schedule.data
+        msch._version += 1
 
 
 @register

@@ -1,0 +1,241 @@
+"""Gluon API tests (reference model: tests/python/unittest/test_gluon*.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _toy_data(n=200, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 2)).astype(np.float32)
+    y = ((x[:, 0] * x[:, 1]) > 0).astype(np.float32)
+    return x, y
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    return net
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    np.testing.assert_allclose(p.data().asnumpy(), np.ones((3, 4)))
+    assert p.grad().shape == (3, 4)
+    assert p.list_ctx()[0].device_type in ("cpu", "tpu")
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(4)
+    dense.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        dense.weight.data()
+    out = dense(mx.nd.ones((2, 3)))
+    assert out.shape == (2, 4)
+    assert dense.weight.shape == (4, 3)
+
+
+def _named_mlp():
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", prefix="fc1_"),
+                nn.Dense(2, prefix="fc2_"))
+    return net
+
+
+def test_block_collect_and_save_load(tmp_path):
+    net = _named_mlp()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.ones((1, 2)))
+    params = net.collect_params()
+    assert len(params.keys()) == 4
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+    net2 = _named_mlp()
+    net2.load_params(fname)
+    out1 = net(mx.nd.ones((3, 2))).asnumpy()
+    out2 = net2(mx.nd.ones((3, 2))).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_gluon_trainer_converges():
+    x, y = _toy_data()
+    X, Y = mx.nd.array(x), mx.nd.array(y)
+    net = _mlp()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(60):
+        with mx.autograd.record():
+            loss = loss_fn(net(X), Y)
+        loss.backward()
+        trainer.step(x.shape[0])
+    acc = (net(X).asnumpy().argmax(1) == y).mean()
+    assert acc > 0.95, acc
+
+
+def test_hybridize_matches_eager_forward_and_grad():
+    """hybridize() (CachedOp jit) must match the imperative path for both
+    outputs and parameter gradients."""
+    x, y = _toy_data(32, seed=4)
+    X, Y = mx.nd.array(x), mx.nd.array(y)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(hybrid):
+        np.random.seed(7)
+        net = _named_mlp()
+        net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2),
+                       force_reinit=True)
+        if hybrid:
+            net.hybridize()
+        with mx.autograd.record():
+            loss = loss_fn(net(X), Y)
+        loss.backward()
+        grads = {k: p.grad().asnumpy()
+                 for k, p in net.collect_params().items()
+                 if p.grad_req != "null"}
+        return loss.asnumpy(), grads
+
+    l_e, g_e = run(False)
+    l_h, g_h = run(True)
+    np.testing.assert_allclose(l_e, l_h, rtol=1e-5)
+    assert set(g_e) == set(g_h)
+    for k in g_e:
+        np.testing.assert_allclose(
+            g_e[k], g_h[k], rtol=1e-4, atol=1e-6,
+            err_msg="hybrid grad mismatch at %s" % k)
+
+
+def test_hybridize_batchnorm_updates_running_stats():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.Flatten(), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 3, 6, 6)
+                    .astype(np.float32))
+    with mx.autograd.record():
+        net(x)
+    rm = [p for n, p in net.collect_params().items()
+          if n.endswith("running_mean")][0]
+    assert float(np.abs(rm.data().asnumpy()).sum()) > 0
+
+
+def test_losses_against_numpy():
+    rng = np.random.RandomState(0)
+    pred = rng.randn(8, 5).astype(np.float32)
+    label = rng.randint(0, 5, (8,)).astype(np.float32)
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(
+        mx.nd.array(pred), mx.nd.array(label)).asnumpy()
+    # numpy reference
+    e = np.exp(pred - pred.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    expected = -np.log(p[np.arange(8), label.astype(int)])
+    np.testing.assert_allclose(l, expected, rtol=1e-5)
+
+    pred2 = rng.randn(8, 3).astype(np.float32)
+    lab2 = rng.randn(8, 3).astype(np.float32)
+    l2 = gluon.loss.L2Loss()(mx.nd.array(pred2), mx.nd.array(lab2)).asnumpy()
+    np.testing.assert_allclose(l2, ((pred2 - lab2) ** 2).mean(1) / 2,
+                               rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(mx.nd.array(pred2), mx.nd.array(lab2)).asnumpy()
+    np.testing.assert_allclose(l1, np.abs(pred2 - lab2).mean(1), rtol=1e-5)
+
+
+def test_fused_lstm_matches_cell_unroll():
+    """gluon.rnn.LSTM (fused lax.scan op) == LSTMCell unrolled with the
+    same weights (reference: FusedRNNCell.unfuse equivalence tests in
+    test_rnn.py)."""
+    T, N, I, H = 4, 3, 5, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+
+    layer = gluon.rnn.LSTM(hidden_size=H, num_layers=1, input_size=I)
+    layer.initialize(mx.init.Xavier())
+    out = layer(mx.nd.array(x)).asnumpy()
+
+    cell = gluon.rnn.LSTMCell(H, input_size=I)
+    cell.initialize(mx.init.Xavier())
+    # copy fused layer weights into the cell
+    lp = {k.split("_", 1)[1]: v for k, v in layer.collect_params().items()
+          if "_l0_" in "_" + k or k.split("_")[-3:-1]}
+    layer_params = dict(layer.collect_params().items())
+    get = lambda suffix: [v for k, v in layer_params.items()  # noqa: E731
+                          if k.endswith(suffix)][0]
+    cell.i2h_weight.set_data(get("l0_i2h_weight").data())
+    cell.h2h_weight.set_data(get("l0_h2h_weight").data())
+    cell.i2h_bias.set_data(get("l0_i2h_bias").data())
+    cell.h2h_bias.set_data(get("l0_h2h_bias").data())
+    outs, _ = cell.unroll(T, mx.nd.array(x), layout="TNC",
+                          merge_outputs=True)
+    np.testing.assert_allclose(out, outs.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_gru_and_rnn_layers_run():
+    for layer in (gluon.rnn.GRU(5, num_layers=2, bidirectional=True),
+                  gluon.rnn.RNN(5, activation="tanh")):
+        layer.initialize(mx.init.Xavier())
+        out = layer(mx.nd.array(np.random.rand(3, 2, 4)
+                                .astype(np.float32)))
+        assert out.shape[0] == 3 and out.shape[1] == 2
+
+
+def test_sequential_rnn_cell_and_modifiers():
+    cell = gluon.rnn.SequentialRNNCell()
+    cell.add(gluon.rnn.LSTMCell(4, input_size=3))
+    cell.add(gluon.rnn.ResidualCell(gluon.rnn.GRUCell(4, input_size=4)))
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(2, 5, 3).astype(np.float32))
+    outs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 4)
+    assert len(states) == 3  # lstm h,c + gru h
+
+
+def test_dataset_dataloader():
+    x = np.arange(40).reshape(20, 2).astype(np.float32)
+    y = np.arange(20).astype(np.float32)
+    ds = gluon.data.ArrayDataset(x, y)
+    assert len(ds) == 20
+    loader = gluon.data.DataLoader(ds, batch_size=6, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 2)
+    assert batches[-1][0].shape == (2, 2)
+    # shuffle covers all samples
+    loader2 = gluon.data.DataLoader(ds, batch_size=5, shuffle=True)
+    seen = np.sort(np.concatenate([b[1].asnumpy() for b in loader2]))
+    np.testing.assert_array_equal(seen, np.arange(20))
+    # threaded prefetch path
+    loader3 = gluon.data.DataLoader(ds, batch_size=5, num_workers=2)
+    assert sum(b[0].shape[0] for b in loader3) == 20
+
+
+def test_model_zoo_constructors():
+    vision = gluon.model_zoo.vision
+    net = vision.get_model("resnet18_v2", classes=10)
+    net.initialize(mx.init.Xavier())
+    out = net(mx.nd.array(np.random.rand(2, 3, 32, 32).astype(np.float32)))
+    assert out.shape == (2, 10)
+    with pytest.raises(ValueError):
+        vision.get_model("not_a_model")
+    # all names constructible (no forward — just graph building)
+    for name in ("alexnet", "vgg11", "squeezenet1_0", "densenet121",
+                 "inception_v3", "mobilenet0_25", "resnet50_v1"):
+        vision.get_model(name)
+
+
+def test_split_and_load_and_clip_global_norm():
+    data = mx.nd.array(np.arange(24).reshape(8, 3).astype(np.float32))
+    parts = gluon.utils.split_data(data, 4)
+    assert [p.shape for p in parts] == [(2, 3)] * 4
+    arrays = [mx.nd.array(np.ones(4).astype(np.float32)),
+              mx.nd.array(np.ones(4).astype(np.float32) * 2)]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-5
+    assert norm > 1.0

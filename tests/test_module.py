@@ -214,6 +214,37 @@ def test_module_predict_and_score():
     assert 0.0 <= res[0][1] <= 1.0
 
 
+@pytest.mark.parametrize("opt_name", ["adam", "nadam", "rmsprop", "adagrad"])
+def test_module_fused_matches_eager_stateful_optimizers(opt_name):
+    """Stateful optimizers must produce identical updates on the fused
+    (traced raw_update) and eager (engine-op) paths."""
+    x, y = _xor_like_data(16, seed=11)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+
+    def make():
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (16, 2))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params(mx.init.Uniform(0.1))
+        return mod
+
+    mod_a, mod_b = make(), make()
+    mod_b.set_params({k: mx.nd.array(v.asnumpy())
+                      for k, v in mod_a.get_params()[0].items()}, {})
+    for m in (mod_a, mod_b):
+        m.init_optimizer(optimizer=opt_name,
+                         optimizer_params={"learning_rate": 0.01})
+    for _ in range(3):
+        mod_a.forward_backward(batch)
+        mod_a.update()
+        mod_b._fit_step(batch)
+    pa, pb = mod_a.get_params()[0], mod_b.get_params()[0]
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg="%s diverged at %s" % (opt_name, k))
+
+
 def test_module_lr_scheduler_no_retrace():
     """LR schedule changes must not retrigger compilation (traced lr)."""
     x, y = _xor_like_data(32)
