@@ -42,6 +42,7 @@ from . import module
 from . import module as mod
 from . import gluon
 from . import models
+from . import rnn
 from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constant
 
 __version__ = "0.1.0"

@@ -17,8 +17,8 @@ from . import ndarray as nd
 from .ndarray import NDArray
 
 __all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Orthogonal",
-           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "One", "Zero",
-           "Constant", "Load", "Mixed", "register", "create"]
+           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "FusedRNN", "One",
+           "Zero", "Constant", "Load", "Mixed", "register", "create"]
 
 _INITIALIZER_REGISTRY: Dict[str, type] = {}
 
@@ -320,6 +320,56 @@ class LSTMBias(Initializer):
         arr[:] = nd.array(b)
 
     _init_bias = _init_weight
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the fused RNN op's packed parameter vector (reference:
+    initializer.py:676 FusedRNN — per-gate init then pack). Weights get
+    ``init`` (default Xavier), biases zero except the LSTM forget gate."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__(init=init.dumps() if hasattr(init, "dumps")
+                         else None, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init if init is not None else Xavier()
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn_op import _GATES
+        gates = _GATES[self._mode]
+        dirs = 2 if self._bidirectional else 1
+        H = self._num_hidden
+        total = arr.size
+        # solve input size from total (see FusedRNNCell._input_size_from)
+        rest = (self._num_layers - 1) * dirs * gates * H * \
+            (dirs * H + H + 2)
+        input_size = (total - rest) // (dirs * gates * H) - H - 2
+        out = np.zeros((total,), dtype=np.float32)
+        p = 0
+        for layer in range(self._num_layers):
+            in_sz = input_size if layer == 0 else H * dirs
+            for _ in range(dirs):
+                for ni in (in_sz, H):
+                    size = gates * H * ni
+                    block = nd.zeros((gates * H, ni))
+                    self._init(InitDesc(desc + "_weight", {}), block)
+                    out[p:p + size] = block.asnumpy().ravel()
+                    p += size
+        for layer in range(self._num_layers):
+            for _ in range(dirs):
+                for _ in range(2):  # bx, bh
+                    if self._mode == "lstm":
+                        out[p + H:p + 2 * H] = self._forget_bias / 2.0
+                    p += gates * H
+        arr[:] = nd.array(out)
 
 
 # name used by Variable(init=...) serialization

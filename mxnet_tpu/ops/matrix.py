@@ -188,6 +188,12 @@ def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
     return tuple(parts)
 
 
+# symbol-layer output arity (reference: SliceChannelParam num_outputs)
+from .registry import get_op as _get_op  # noqa: E402
+_get_op("SliceChannel").num_outputs = \
+    lambda attrs: int(attrs.get("num_outputs", 1))
+
+
 @register("where", num_inputs=3)
 def where(condition, x, y):
     """Elementwise select (reference: src/operator/tensor/control_flow_op.cc).

@@ -176,3 +176,10 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
     if mode == "lstm":
         return x, h_out, jnp.stack(c_finals)
     return x, h_out
+
+
+# symbol-layer output arity (reference: RNNParam state_outputs)
+from .registry import get_op as _get_op  # noqa: E402
+_get_op("RNN").num_outputs = lambda attrs: (
+    1 if not attrs.get("state_outputs") else
+    (3 if attrs.get("mode", "lstm") == "lstm" else 2))

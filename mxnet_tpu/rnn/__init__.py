@@ -1,0 +1,8 @@
+"""Symbolic RNN toolkit (reference: python/mxnet/rnn/)."""
+from .rnn_cell import *
+from .io import *
+
+from . import rnn_cell
+from . import io
+
+__all__ = rnn_cell.__all__ + io.__all__

@@ -555,11 +555,24 @@ def _infer_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]],
     aux_names = sym.list_auxiliary_states()
 
     resolved = dict(known)
-    # shapes pinned on Variables via shape= attr
+    batch_size = resolved.pop("__batch_size__", None)
+    # shapes pinned on Variables via shape= attr; wildcard (0) dims stand
+    # for the batch dimension (reference convention: state_info shapes are
+    # (0, H) with __layout__ marking the N axis) and resolve from the
+    # caller-provided batch hint
     for node in _topo_order(sym._entries):
         if node.is_variable and "__shape__" in node.str_attrs and \
                 node.name not in resolved:
-            resolved[node.name] = ast.literal_eval(node.str_attrs["__shape__"])
+            shape = list(ast.literal_eval(node.str_attrs["__shape__"]))
+            if any(s == 0 for s in shape) and batch_size:
+                layout = node.str_attrs.get("__layout__", "")
+                n_axis = layout.find("N")
+                if 0 <= n_axis < len(shape) and shape[n_axis] == 0:
+                    shape[n_axis] = int(batch_size)
+                else:
+                    shape = [int(batch_size) if s == 0 else s
+                             for s in shape]
+            resolved[node.name] = tuple(shape)
 
     missing = [n for n in arg_names + aux_names if n not in resolved]
     if missing:
