@@ -16,6 +16,7 @@ Usage mirrors the reference::
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_devices
 from .name import NameManager, AttrScope
+from . import amp
 from . import ops
 from . import ndarray
 from . import ndarray as nd

@@ -1,0 +1,83 @@
+"""Automatic mixed precision — bf16 compute with fp32 master weights.
+
+Reference parity: the reference's fp16 story is "cast the symbol/data to
+float16 and use SGD(multi_precision=True)" (python/mxnet/optimizer.py SGD
+multi_precision; tests/python/train/test_dtype.py). On TPU the idiomatic
+equivalent is bfloat16 *compute* with float32 *storage*: parameters and
+optimizer state stay fp32, and the MXU-bound ops (Convolution,
+FullyConnected, Deconvolution, fused RNN) cast their operands to bf16 at
+trace time, accumulating in fp32 on the MXU (``preferred_element_type``).
+
+This is a trace-time policy: set it before building jitted programs
+(``Module.bind`` / ``init_optimizer`` / first ``HybridBlock`` call)::
+
+    mx.amp.init("bfloat16")      # turn on for subsequently-built programs
+    mx.amp.off()                  # back to full precision
+    with mx.amp.scope("bfloat16"):
+        ...                       # policy active within the block
+
+Already-compiled programs are unaffected (XLA caches by shape/dtype, and
+the policy is read when the graph is traced, not when it runs).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+__all__ = ["init", "off", "active", "compute_dtype", "cast_compute", "scope"]
+
+_COMPUTE_DTYPE = None
+
+_ALLOWED = ("bfloat16", "float16")
+
+
+def init(dtype="bfloat16"):
+    """Enable mixed precision: matmul/conv operands cast to ``dtype``."""
+    global _COMPUTE_DTYPE
+    name = jnp.dtype(dtype).name
+    if name not in _ALLOWED:
+        raise ValueError("amp compute dtype must be one of %s, got %r"
+                         % (_ALLOWED, name))
+    _COMPUTE_DTYPE = jnp.dtype(dtype)
+
+
+def off():
+    """Disable mixed precision for subsequently-traced programs."""
+    global _COMPUTE_DTYPE
+    _COMPUTE_DTYPE = None
+
+
+def active() -> bool:
+    return _COMPUTE_DTYPE is not None
+
+
+def compute_dtype():
+    """The low-precision compute dtype, or None when amp is off."""
+    return _COMPUTE_DTYPE
+
+
+def cast_compute(*arrays):
+    """Cast float32 operands to the compute dtype (no-op when amp is off).
+
+    Non-float32 operands (ints, already-low-precision floats, None bias)
+    pass through untouched.
+    """
+    if _COMPUTE_DTYPE is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    out = tuple(a.astype(_COMPUTE_DTYPE)
+                if a is not None and getattr(a, "dtype", None) == jnp.float32
+                else a for a in arrays)
+    return out if len(out) != 1 else out[0]
+
+
+@contextmanager
+def scope(dtype="bfloat16"):
+    """Context manager form of :func:`init`/:func:`off`."""
+    global _COMPUTE_DTYPE
+    prev = _COMPUTE_DTYPE
+    init(dtype)
+    try:
+        yield
+    finally:
+        _COMPUTE_DTYPE = prev

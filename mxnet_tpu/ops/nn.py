@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, OP_REGISTRY
+from .. import amp
 
 # ----------------------------------------------------------------- helpers
 
@@ -133,13 +134,19 @@ def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
     accumulation even for bf16 inputs.
     """
     x = data.reshape(data.shape[0], -1) if (flatten and data.ndim > 2) else data
+    x, weight = amp.cast_compute(x, weight)
+    # bf16 operands: the MXU accumulates in fp32 natively and rounds the
+    # result; requesting preferred_element_type=f32 there breaks the conv/dot
+    # transpose rule (f32 cotangent vs bf16 operand) for no extra precision.
+    acc = {"preferred_element_type": jnp.float32} \
+        if jnp.result_type(x, weight) == jnp.float32 else {}
     out = lax.dot_general(
         x, weight,
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        **acc,
     ).astype(jnp.result_type(x, weight))
     if not no_bias and bias is not None:
-        out = out + bias
+        out = out + bias.astype(out.dtype)
     return out
 
 
@@ -159,6 +166,9 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _tup(dilate, nd) or (1,) * nd
     pad = _tup(pad, nd) or (0,) * nd
     dn = _conv_dnums(nd)
+    data, weight = amp.cast_compute(data, weight)
+    acc = {"preferred_element_type": jnp.float32} \
+        if jnp.result_type(data, weight) == jnp.float32 else {}
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -166,10 +176,10 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32,
+        **acc,
     ).astype(jnp.result_type(data, weight))
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + bias.reshape((1, -1) + (1,) * nd).astype(out.dtype)
     return out
 
 
@@ -196,6 +206,9 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
     w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
     eff_k = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
     pads = [(ek - 1 - p, ek - 1 - p + a) for ek, p, a in zip(eff_k, pad, adj)]
+    data, w = amp.cast_compute(data, w)
+    acc = {"preferred_element_type": jnp.float32} \
+        if jnp.result_type(data, w) == jnp.float32 else {}
     out = lax.conv_general_dilated(
         data, w,
         window_strides=(1,) * nd,
@@ -204,10 +217,10 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
         rhs_dilation=dilate,
         dimension_numbers=_conv_dnums(nd),
         feature_group_count=g,
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.result_type(data, weight))
+        **acc,
+    ).astype(jnp.result_type(data, w))
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + bias.reshape((1, -1) + (1,) * nd).astype(out.dtype)
     return out
 
 
@@ -455,6 +468,9 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                    normalization="null", out_grad=False, smooth_alpha=0.0):
     """Softmax forward with cross-entropy backward (reference:
     src/operator/softmax_output-inl.h; `Softmax` is the 0.11 alias)."""
+    if amp.active() and data.dtype == amp.compute_dtype():
+        # keep the loss head in fp32 under mixed precision
+        data = data.astype(jnp.float32)
     return _softmax_output_p(
         data, label,
         _attrs_key(grad_scale=grad_scale, ignore_label=ignore_label,
