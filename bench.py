@@ -3,10 +3,12 @@
 Baseline: the reference's published training number for ResNet-50 at batch 32
 — 181.53 img/s on P100 (BASELINE.md, docs/how_to/perf.md:180-190). This
 script runs the same workload through the TPU-native stack: one fused
-forward+backward+SGD-update XLA program built by Module._build_fused_step.
+forward+backward+SGD-update XLA program built by Module._build_fused_step,
+in bf16 mixed precision (fp32 master weights, bf16 MXU compute — mx.amp).
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N/181.53}
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N/181.53,
+   "mfu": ..., "batch": ..., "flops_per_img": ..., "peak_flops": ...}
 """
 import json
 import sys
@@ -15,9 +17,26 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 1)[0] if "/" in __file__ else ".")
 
 BASELINE_IMG_S = 181.53   # P100 training, ResNet-50 batch 32
-BATCH = 32
+BATCH = 256
 WARMUP = 3
 ITERS = 20
+
+# Analytic model FLOPs: ResNet-50 @224x224 forward = 4.089e9 multiply-adds
+# (= 8.18 GFLOP at 2 FLOPs/MAC); training step ~ 3x forward (fwd + 2x in bwd).
+FWD_MACS_PER_IMG = 4.089e9
+TRAIN_FLOPS_PER_IMG = 2 * FWD_MACS_PER_IMG * 3
+
+# Dense bf16 peak FLOP/s by TPU generation (device_kind substring match).
+_PEAK = [("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+         ("v6", 918e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12)]
+
+
+def _peak_flops(device_kind: str):
+    dk = device_kind.lower()
+    for sub, peak in _PEAK:
+        if sub in dk:
+            return peak
+    return None  # unknown device: report img/s only, no fabricated MFU
 
 
 def main():
@@ -26,12 +45,17 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu.models import resnet
 
-    ctx = mx.tpu(0) if mx.num_devices("tpu") else mx.cpu(0)
+    on_tpu = bool(mx.num_devices("tpu"))
+    ctx = mx.tpu(0) if on_tpu else mx.cpu(0)
+    batch = BATCH if on_tpu else 8
+    iters = ITERS if on_tpu else 3
+
+    mx.amp.init("bfloat16")   # bf16 MXU compute, fp32 master weights
 
     sym = resnet.get_symbol(num_classes=1000, num_layers=50)
     mod = mx.mod.Module(sym, context=ctx)
-    mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
-             label_shapes=[("softmax_label", (BATCH,))])
+    mod.bind(data_shapes=[("data", (batch, 3, 224, 224))],
+             label_shapes=[("softmax_label", (batch,))])
     mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
                                    magnitude=2))
     mod.init_optimizer(optimizer="sgd",
@@ -39,27 +63,33 @@ def main():
                                          "momentum": 0.9, "wd": 1e-4})
 
     rng = np.random.RandomState(0)
-    x = rng.uniform(-1, 1, (BATCH, 3, 224, 224)).astype(np.float32)
-    y = rng.randint(0, 1000, (BATCH,)).astype(np.float32)
-    batch = mx.io.DataBatch(data=[mx.nd.array(x, ctx=ctx)],
-                            label=[mx.nd.array(y, ctx=ctx)])
+    x = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.float32)
+    dbatch = mx.io.DataBatch(data=[mx.nd.array(x, ctx=ctx)],
+                             label=[mx.nd.array(y, ctx=ctx)])
 
     for _ in range(WARMUP):
-        mod._fit_step(batch)
+        mod._fit_step(dbatch)
     jax.block_until_ready(mod._exec.arg_dict["fc1_weight"].data)
 
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        mod._fit_step(batch)
+    for _ in range(iters):
+        mod._fit_step(dbatch)
     jax.block_until_ready(mod._exec.arg_dict["fc1_weight"].data)
     dt = time.perf_counter() - t0
 
-    img_s = BATCH * ITERS / dt
+    img_s = batch * iters / dt
+    peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else None
+    mfu = round(img_s * TRAIN_FLOPS_PER_IMG / peak, 4) if peak else None
     print(json.dumps({
-        "metric": "resnet50_train_batch32",
+        "metric": "resnet50_train_bf16",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "mfu": mfu,
+        "batch": batch,
+        "flops_per_img": TRAIN_FLOPS_PER_IMG,
+        "peak_flops": peak,
     }))
 
 

@@ -25,7 +25,8 @@ from contextlib import contextmanager
 
 import jax.numpy as jnp
 
-__all__ = ["init", "off", "active", "compute_dtype", "cast_compute", "scope"]
+__all__ = ["init", "off", "active", "compute_dtype", "cast_compute",
+           "mxu_operands", "scope"]
 
 _COMPUTE_DTYPE = None
 
@@ -69,6 +70,29 @@ def cast_compute(*arrays):
                 if a is not None and getattr(a, "dtype", None) == jnp.float32
                 else a for a in arrays)
     return out if len(out) != 1 else out[0]
+
+
+def mxu_operands(a, b, conv=False):
+    """Cast two MXU operands under the amp policy and pick the accumulation
+    request for ``lax.dot_general`` / ``lax.conv_general_dilated``.
+
+    Returns ``(a, b, acc_kwargs)``. ``dot_general``'s transpose rule accepts
+    a fp32 cotangent against low-precision operands, so bf16/fp16 matmuls
+    always request fp32 accumulation explicitly. ``conv_general_dilated``'s
+    transpose requires operand/cotangent dtypes to match, so convs request it
+    only when the operands are fp32 — on TPU the MXU accumulates bf16
+    products in fp32 natively either way, so this loses nothing on the
+    target hardware (non-TPU backends may accumulate low-precision convs in
+    the operand dtype).
+    """
+    a, b = cast_compute(a, b)
+    rt = jnp.result_type(a, b)
+    low = rt in (jnp.bfloat16, jnp.float16)
+    if rt == jnp.float32 or (low and not conv):
+        acc = {"preferred_element_type": jnp.float32}
+    else:
+        acc = {}
+    return a, b, acc
 
 
 @contextmanager

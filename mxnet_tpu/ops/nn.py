@@ -131,15 +131,11 @@ def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
 
     The reference checks the cuBLAS handle and calls gemm
     (fully_connected-inl.h:88); here ``dot_general`` hits the MXU with fp32
-    accumulation even for bf16 inputs.
+    accumulation requested explicitly for fp32 and bf16/fp16 inputs alike
+    (amp.mxu_operands).
     """
     x = data.reshape(data.shape[0], -1) if (flatten and data.ndim > 2) else data
-    x, weight = amp.cast_compute(x, weight)
-    # bf16 operands: the MXU accumulates in fp32 natively and rounds the
-    # result; requesting preferred_element_type=f32 there breaks the conv/dot
-    # transpose rule (f32 cotangent vs bf16 operand) for no extra precision.
-    acc = {"preferred_element_type": jnp.float32} \
-        if jnp.result_type(x, weight) == jnp.float32 else {}
+    x, weight, acc = amp.mxu_operands(x, weight)
     out = lax.dot_general(
         x, weight,
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
@@ -166,9 +162,7 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _tup(dilate, nd) or (1,) * nd
     pad = _tup(pad, nd) or (0,) * nd
     dn = _conv_dnums(nd)
-    data, weight = amp.cast_compute(data, weight)
-    acc = {"preferred_element_type": jnp.float32} \
-        if jnp.result_type(data, weight) == jnp.float32 else {}
+    data, weight, acc = amp.mxu_operands(data, weight, conv=True)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -206,9 +200,7 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
     w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
     eff_k = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
     pads = [(ek - 1 - p, ek - 1 - p + a) for ek, p, a in zip(eff_k, pad, adj)]
-    data, w = amp.cast_compute(data, w)
-    acc = {"preferred_element_type": jnp.float32} \
-        if jnp.result_type(data, w) == jnp.float32 else {}
+    data, w, acc = amp.mxu_operands(data, w, conv=True)
     out = lax.conv_general_dilated(
         data, w,
         window_strides=(1,) * nd,

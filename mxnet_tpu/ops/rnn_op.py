@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register
+from .. import amp
 
 __all__ = ["rnn_param_size", "rnn_unpack_params"]
 
@@ -145,6 +146,13 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
     H = int(state_size)
     L = int(num_layers)
     dirs = 2 if bidirectional else 1
+    # amp: the whole recurrence (input projection + per-step gate matmul)
+    # runs in the compute dtype, matching cuDNN's fp16 RNN semantics; the
+    # packed master parameters stay fp32 outside the trace.
+    data, parameters = amp.cast_compute(data, parameters)
+    state = amp.cast_compute(state)
+    if state_cell is not None:
+        state_cell = amp.cast_compute(state_cell)
     weights, biases = rnn_unpack_params(parameters, L, input_size, H, mode,
                                         bidirectional)
 

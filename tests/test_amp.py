@@ -1,0 +1,104 @@
+"""Mixed-precision (mx.amp) policy tests.
+
+Reference parity: the reference's fp16 story is cast-to-fp16 +
+SGD(multi_precision=True) (tests/python/train/test_dtype.py,
+python/mxnet/optimizer.py SGD). Here the policy is trace-time: bf16 MXU
+compute, fp32 master weights.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    mx.amp.off()
+
+
+def test_cast_compute_policy():
+    import jax.numpy as jnp
+    a = jnp.ones((4, 4), jnp.float32)
+    i = jnp.ones((4,), jnp.int32)
+    assert mx.amp.cast_compute(a).dtype == jnp.float32   # off: no-op
+    mx.amp.init("bfloat16")
+    assert mx.amp.active()
+    out_a, out_i = mx.amp.cast_compute(a, i)
+    assert out_a.dtype == jnp.bfloat16
+    assert out_i.dtype == jnp.int32                      # non-f32 untouched
+    mx.amp.off()
+    assert not mx.amp.active()
+
+
+def test_mxu_operands_accumulation_request():
+    import jax.numpy as jnp
+    a32 = jnp.ones((2, 2), jnp.float32)
+    b16 = jnp.ones((2, 2), jnp.bfloat16)
+    # fp32 matmul and conv both request fp32 accumulation
+    _, _, acc = mx.amp.mxu_operands(a32, a32)
+    assert acc == {"preferred_element_type": jnp.float32}
+    _, _, acc = mx.amp.mxu_operands(a32, a32, conv=True)
+    assert acc == {"preferred_element_type": jnp.float32}
+    # bf16 dot: explicit fp32 accumulation; bf16 conv: operand dtype
+    # (conv transpose rule forbids mixed dtypes; MXU accumulates fp32 anyway)
+    _, _, acc = mx.amp.mxu_operands(b16, b16)
+    assert acc == {"preferred_element_type": jnp.float32}
+    _, _, acc = mx.amp.mxu_operands(b16, b16, conv=True)
+    assert acc == {}
+
+
+def test_amp_dense_conv_compute_dtype():
+    mx.amp.init("bfloat16")
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32))
+    w = mx.nd.array(np.random.RandomState(1).rand(4, 3, 3, 3).astype(np.float32))
+    out = mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=4, no_bias=True)
+    assert str(out.dtype) == "bfloat16"
+    xf = mx.nd.array(np.random.RandomState(2).rand(2, 8).astype(np.float32))
+    wf = mx.nd.array(np.random.RandomState(3).rand(5, 8).astype(np.float32))
+    out = mx.nd.FullyConnected(xf, wf, num_hidden=5, no_bias=True)
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_amp_fused_rnn_compute_dtype():
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    T, N, I, H = 3, 2, 4, 5
+    n = rnn_param_size(1, I, H, "lstm")
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.rand(T, N, I).astype(np.float32))
+    params = mx.nd.array(rng.rand(n).astype(np.float32) * 0.1)
+    state = mx.nd.zeros((1, N, H))
+    cell = mx.nd.zeros((1, N, H))
+    out32 = mx.nd.RNN(data, params, state, cell, state_size=H,
+                      num_layers=1, mode="lstm")
+    assert str(out32.dtype) == "float32"
+    mx.amp.init("bfloat16")
+    out16 = mx.nd.RNN(data, params, state, cell, state_size=H,
+                      num_layers=1, mode="lstm")
+    assert str(out16.dtype) == "bfloat16"
+    np.testing.assert_allclose(out16.asnumpy().astype(np.float32),
+                               out32.asnumpy(), rtol=0.1, atol=0.05)
+
+
+def test_amp_module_fit_master_weights_fp32():
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (200, 2)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.float32)
+    d = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    a1 = mx.sym.Activation(f1, act_type="tanh")
+    f2 = mx.sym.FullyConnected(a1, num_hidden=2, name="fc2")
+    sym = mx.sym.SoftmaxOutput(f2, name="softmax")
+
+    mx.amp.init("bfloat16")
+    it = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=True)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            num_epoch=15)
+    params = mod.get_params()[0]
+    for name, arr in params.items():
+        assert str(arr.dtype) == "float32", (name, arr.dtype)
+    it.reset()
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
