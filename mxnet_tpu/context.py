@@ -50,17 +50,20 @@ class Context:
     def jax_device(self) -> jax.Device:
         """Resolve to the concrete jax.Device (lazy: devices may not exist
         until the backend initializes)."""
+        # device ids index the PROCESS-LOCAL view (the reference's gpu(i) is
+        # worker-local too); under jax.distributed the global list contains
+        # other hosts' non-addressable devices
         if self.device_type == "cpu":
-            return jax.devices("cpu")[self.device_id]
+            return jax.local_devices(backend="cpu")[self.device_id]
         # accelerator: prefer the default backend's devices when it is not CPU
-        devs = jax.devices()
+        devs = jax.local_devices()
         if devs and devs[0].platform != "cpu":
             return devs[self.device_id]
         # No accelerator present (pure-CPU test run): fall back to host devices
         # so tpu(i) still resolves — mirrors the reference test trick of running
         # "multi-device" suites on cpu(0)/cpu(1) (tests/python/unittest/
         # test_multi_device_exec.py, SURVEY.md §4).
-        cpus = jax.devices("cpu")
+        cpus = jax.local_devices(backend="cpu")
         return cpus[self.device_id % len(cpus)]
 
     def __eq__(self, other):
@@ -116,8 +119,8 @@ def num_devices(device_type: str = "tpu") -> int:
     mx.context.num_gpus()."""
     try:
         if device_type == "cpu":
-            return len(jax.devices("cpu"))
-        devs = jax.devices()
+            return len(jax.local_devices(backend="cpu"))
+        devs = jax.local_devices()
         if devs and devs[0].platform != "cpu":
             return len(devs)
         return 0

@@ -99,6 +99,19 @@ class KVStore(object):
                 raise MXNetError("key %r already initialized" % (k,))
             self._store[k] = vlist[0].copy()
 
+    @staticmethod
+    def _local_reduce(vlist):
+        """Sum per-device values onto the first value's device (reference:
+        local reduce src/kvstore/comm.h:85)."""
+        acc = vlist[0].data
+        dev = acc.device if hasattr(acc, "device") else None
+        for v in vlist[1:]:
+            d = v.data
+            if dev is not None and getattr(d, "device", None) != dev:
+                d = jax.device_put(d, dev)
+            acc = acc + d
+        return acc
+
     def push(self, key, value, priority: int = 0):
         """Aggregate (sum) pushed values; if an updater is set, apply it to
         the stored weight (reference: kvstore.py push; local reduce
@@ -109,16 +122,8 @@ class KVStore(object):
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % (k,))
-            merged = vlist[0]
-            if len(vlist) > 1:
-                acc = merged.data
-                dev = acc.device if hasattr(acc, "device") else None
-                for v in vlist[1:]:
-                    d = v.data
-                    if dev is not None and getattr(d, "device", None) != dev:
-                        d = jax.device_put(d, dev)
-                    acc = acc + d
-                merged = NDArray(acc)
+            merged = vlist[0] if len(vlist) == 1 \
+                else NDArray(self._local_reduce(vlist))
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
@@ -193,6 +198,63 @@ class KVStore(object):
         return False
 
 
+class DistKVStore(KVStore):
+    """Multi-process kvstore over ``parallel.dist`` (reference:
+    src/kvstore/kvstore_dist.h:50-320 + kvstore_dist_server.h:105-250).
+
+    There is no server role: every process holds a replica of the store and
+    applies the same updater to the same cross-process gradient sum, so
+    replicas stay bit-identical — the SPMD equivalent of the server's
+    single authoritative copy. ``push`` = local reduce + allreduce;
+    ``pull`` reads the local replica (already synchronized).
+
+    ``dist_async`` is accepted but behaves synchronously: XLA collectives
+    are bulk-synchronous by construction; there is no stale-push mode.
+    """
+
+    def __init__(self, kind: str):
+        super().__init__(kind)
+        from .parallel import dist
+        self._dist = dist
+
+    @property
+    def rank(self) -> int:
+        return self._dist.rank()
+
+    @property
+    def num_workers(self) -> int:
+        return self._dist.num_workers()
+
+    def barrier(self):
+        nd.waitall()
+        self._dist.barrier()
+
+    def init(self, key, value):
+        """Rank 0's value wins (reference: only one worker's init reaches
+        the server; others' are ignored, kvstore_dist.h Push_ init path)."""
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            synced = self._dist.broadcast(vlist[0].data, root=0)
+            self._store[k] = NDArray(synced)
+
+    def push(self, key, value, priority: int = 0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = NDArray(self._dist.allreduce_sum(
+                self._local_reduce(vlist)))
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._data = self._store[k].data + merged.data
+                self._store[k]._version += 1
+
+
 def create(name: str = "local") -> KVStore:
     """Factory (reference: src/kvstore/kvstore.cc:34-61 — substring grammar:
     'device' → device-side reduce, 'dist' → multi-process, '_async' → async
@@ -205,11 +267,17 @@ def create(name: str = "local") -> KVStore:
     if name not in valid:
         raise MXNetError("Unknown KVStore type %r" % name)
     if "dist" in name:
-        # multi-host rendezvous (no-op when jax.distributed already
-        # initialized by the launcher, or single-process)
-        try:
-            if jax.process_count() == 1:
-                pass
-        except Exception:
-            pass
+        from .parallel import dist
+        if not dist.is_initialized():
+            # NB: probe only env + coordination state here — calling
+            # num_workers() could initialize a backend as a side effect,
+            # which would make the remedy below impossible
+            if dist.cluster_env() is None and not dist.coordination_active():
+                raise MXNetError(
+                    "kvstore %r needs a cluster: launch with tools/launch.py "
+                    "-n N (sets the DMLC_* env) or call "
+                    "mxnet_tpu.parallel.dist.initialize(...) first; for "
+                    "single-host multi-device training use 'device'" % name)
+            dist.initialize()
+        return DistKVStore(name)
     return KVStore(name)

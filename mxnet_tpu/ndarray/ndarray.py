@@ -49,7 +49,13 @@ def _ctx_of(data: jax.Array) -> Context:
         # report the first component device's context
         dev = sorted(data.devices(), key=lambda d: d.id)[0]
     kind = "cpu" if dev.platform == "cpu" else "tpu"
-    return Context(kind, dev.id)
+    # Context ids are process-local (context.py jax_device); map the global
+    # device id back to its position in this process's local view
+    try:
+        local = jax.local_devices(backend=dev.platform)
+        return Context(kind, local.index(dev))
+    except (ValueError, RuntimeError):
+        return Context(kind, dev.id)
 
 
 class NDArray:
@@ -130,8 +136,13 @@ class NDArray:
     # ------------------------------------------------------- sync points
     def asnumpy(self) -> np.ndarray:
         """Blocking device->host copy (reference: ndarray.py asnumpy /
-        SyncCopyToCPU src/ndarray/ndarray.cc:779)."""
-        return np.asarray(self._data)
+        SyncCopyToCPU src/ndarray/ndarray.cc:779). A *copy*, like the
+        reference: callers may mutate the result without touching the
+        device buffer (np.asarray of a jax array is a read-only view)."""
+        out = np.asarray(self._data)
+        if not out.flags.writeable:
+            out = out.copy()
+        return out
 
     def asscalar(self):
         if self.size != 1:

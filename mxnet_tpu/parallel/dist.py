@@ -1,0 +1,217 @@
+"""Multi-host runtime: process bootstrap + cross-process collectives.
+
+Reference: the ps-lite runtime (SURVEY.md §2.12) — ``src/kvstore/
+kvstore_dist.h:50-320`` workers push/pull against server processes spawned by
+``tools/launch.py``, wired together by DMLC_* environment variables
+(``DMLC_PS_ROOT_URI``, ``DMLC_PS_ROOT_PORT``, ``DMLC_NUM_WORKER``,
+``DMLC_WORKER_ID``, ``DMLC_ROLE``).
+
+TPU design: there are no server processes. Every process is a worker running
+the same SPMD program; ``jax.distributed.initialize`` is the rendezvous
+(scheduler) and cross-host reduction is an XLA collective over a one-
+device-per-process mesh — DCN/gloo between hosts, ICI within a slice. The
+launcher keeps the reference's env protocol so `tools/launch.py -n N cmd`
+works unchanged.
+
+This module is the only place that talks to ``jax.distributed``; kvstore's
+``dist_*`` types and ``gluon.Trainer`` build on it.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional
+
+__all__ = ["initialize", "is_initialized", "cluster_env", "rank",
+           "num_workers", "allreduce_sum", "broadcast", "barrier"]
+
+_INITIALIZED = False
+_COMM = None          # (mesh, local_device) cache
+_FN_CACHE = {}
+
+
+def cluster_env() -> Optional[dict]:
+    """Parse the launcher's DMLC_* env protocol; None when not under a
+    launcher (reference: ps-lite postoffice reads the same variables)."""
+    uri = os.environ.get("DMLC_PS_ROOT_URI")
+    port = os.environ.get("DMLC_PS_ROOT_PORT")
+    n = os.environ.get("DMLC_NUM_WORKER")
+    wid = os.environ.get("DMLC_WORKER_ID")
+    if uri is None or port is None or n is None or wid is None:
+        return None
+    return {"coordinator": "%s:%s" % (uri, port),
+            "num_workers": int(n), "rank": int(wid)}
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def coordination_active() -> bool:
+    """True when a jax.distributed coordination client exists (a pure state
+    probe — never initializes a backend)."""
+    try:
+        from jax._src import distributed as _jdist
+        return getattr(_jdist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Join the cluster (idempotent). Arguments default to the DMLC_* env.
+
+    Must run before any backend is initialized in this process — the global
+    device view and the gloo/DCN collectives are fixed at backend creation.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    try:
+        from jax._src import distributed as _jdist
+        if getattr(_jdist.global_state, "client", None) is not None:
+            _INITIALIZED = True   # user already ran jax.distributed.initialize
+            return
+    except Exception:
+        pass
+    env = cluster_env()
+    if coordinator_address is None and env is not None:
+        coordinator_address = env["coordinator"]
+        num_processes = env["num_workers"]
+        process_id = env["rank"]
+    if coordinator_address is None:
+        raise RuntimeError(
+            "distributed init needs a coordinator: run under tools/launch.py "
+            "(sets DMLC_PS_ROOT_URI/PORT, DMLC_NUM_WORKER, DMLC_WORKER_ID) "
+            "or pass coordinator_address/num_processes/process_id")
+    import jax
+    from jax._src import xla_bridge
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError(
+            "a jax backend is already initialized; distributed rendezvous "
+            "must happen first (create the dist kvstore before touching "
+            "devices)")
+    try:
+        # multi-process CPU collectives ride gloo; TPU backends ignore this
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _INITIALIZED = True
+
+
+def rank() -> int:
+    # authoritative: the coordination-service state (jax.process_index()
+    # reads the *default backend*, which may be a single-chip view)
+    try:
+        from jax._src import distributed as _jdist
+        if getattr(_jdist.global_state, "client", None) is not None:
+            return _jdist.global_state.process_id or 0
+    except Exception:
+        pass
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def num_workers() -> int:
+    try:
+        from jax._src import distributed as _jdist
+        if getattr(_jdist.global_state, "client", None) is not None:
+            return _jdist.global_state.num_processes or 1
+    except Exception:
+        pass
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _comm():
+    """One-device-per-process mesh for cross-process reductions.
+
+    Prefers the default backend (a TPU slice spans all processes natively);
+    falls back to the CPU backend, whose gloo collectives span hosts when
+    ``initialize`` ran first.
+    """
+    global _COMM
+    if _COMM is not None:
+        return _COMM
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    n = num_workers()
+
+    def pick(devs):
+        by_proc = {}
+        for d in devs:
+            by_proc.setdefault(d.process_index, d)
+        if len(by_proc) < n:
+            return None
+        return [by_proc[i] for i in range(n)]
+
+    devs = pick(jax.devices())
+    if devs is None:
+        devs = pick(jax.devices("cpu"))
+    if devs is None:
+        raise RuntimeError(
+            "no backend spans all %d processes — was dist.initialize() "
+            "called before the first device access?" % n)
+    mesh = Mesh(np.array(devs), ("proc",))
+    local = devs[rank()]
+    _COMM = (mesh, local)
+    return _COMM
+
+
+def _psum_fn(shape, dtype):
+    key = ("psum", shape, str(dtype))
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        import jax
+        from jax.sharding import PartitionSpec as P
+        mesh, _ = _comm()
+        shard = partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+                        out_specs=P())
+        fn = jax.jit(shard(lambda s: jax.lax.psum(s[0], "proc")))
+        _FN_CACHE[key] = fn
+    return fn
+
+
+def allreduce_sum(x):
+    """Sum an identically-shaped per-process array across all processes;
+    returns the reduction as a local jax array (replicated semantics)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    n = num_workers()
+    if n == 1:
+        return jnp.asarray(x)
+    mesh, local = _comm()
+    xl = jax.device_put(jnp.asarray(x), local)
+    garr = jax.make_array_from_single_device_arrays(
+        (n,) + xl.shape, NamedSharding(mesh, P("proc")), [xl[None]])
+    out = _psum_fn(xl.shape, xl.dtype)(garr)
+    return out.addressable_data(0)
+
+
+def broadcast(x, root: int = 0):
+    """Every process gets ``root``'s value (psum of one-hot contribution)."""
+    import jax.numpy as jnp
+    if num_workers() == 1:
+        return jnp.asarray(x)
+    contrib = jnp.asarray(x) if rank() == root else jnp.zeros_like(
+        jnp.asarray(x))
+    return allreduce_sum(contrib)
+
+
+def barrier():
+    """Block until every process reaches this point."""
+    import jax
+    if num_workers() == 1:
+        return
+    jax.block_until_ready(allreduce_sum(jax.numpy.zeros((1,))))

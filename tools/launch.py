@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Cluster launcher (reference: tools/launch.py:50-80 + dmlc tracker).
+
+Spawns N worker processes wired together by the DMLC_* env protocol the
+reference's ps-lite used; here the variables point every worker at the
+jax.distributed coordinator (rank 0's host:port) instead of a scheduler
+process, and there are no server processes (-s is accepted for CLI parity
+and ignored — the SPMD design has no server role).
+
+Launchers:
+  local — N processes on this host (reference `--launcher local`, the
+          tests/nightly/dist_sync_kvstore.py pattern)
+  ssh   — one process per line of --hostfile via passwordless ssh
+          (reference `--launcher ssh`)
+
+Usage:
+  python tools/launch.py -n 4 python train.py --kv-store dist_sync
+  python tools/launch.py -n 2 --launcher ssh -H hosts python train.py
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(base, rank, n, uri, port):
+    env = dict(base)
+    env.update({
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": uri,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_NUM_SERVER": "0",
+        "DMLC_WORKER_ID": str(rank),
+    })
+    return env
+
+
+def launch_local(args, command):
+    port = args.port or _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = _worker_env(os.environ, rank, args.num_workers,
+                          "127.0.0.1", port)
+        procs.append(subprocess.Popen(command, env=env))
+    return _wait(procs)
+
+
+def launch_ssh(args, command):
+    import shlex
+    with open(args.hostfile) as fin:
+        hosts = [h.strip() for h in fin if h.strip()
+                 and not h.startswith("#")]
+    if len(hosts) < args.num_workers:
+        sys.exit("hostfile has %d hosts, need %d" % (len(hosts),
+                                                     args.num_workers))
+    if args.port is None:
+        # a port probed locally says nothing about hosts[0], where the
+        # coordinator actually binds
+        sys.exit("--launcher ssh needs an explicit --port free on the "
+                 "first host (the jax.distributed coordinator binds there)")
+    port = args.port
+    uri = hosts[0]
+    cwd = os.getcwd()
+    procs = []
+    for rank in range(args.num_workers):
+        envs = " ".join("%s=%s" % (k, shlex.quote(str(v))) for k, v in
+                        _worker_env({}, rank, args.num_workers, uri,
+                                    port).items())
+        remote = "cd %s; env %s %s" % (
+            shlex.quote(cwd), envs,
+            " ".join(shlex.quote(str(c)) for c in command))
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank], remote]))
+    return _wait(procs)
+
+
+def _wait(procs):
+    rc = 0
+    try:
+        for p in procs:
+            r = p.wait()
+            rc = rc or r
+    except KeyboardInterrupt:
+        rc = 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="launch a distributed training job",
+        usage="launch.py [-h] -n NUM_WORKERS [opts] command ...")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI parity; the SPMD "
+                         "design has no server processes")
+    ap.add_argument("--launcher", choices=("local", "ssh"), default="local")
+    ap.add_argument("-H", "--hostfile", help="hostfile for --launcher ssh")
+    ap.add_argument("--port", type=int, default=None,
+                    help="coordinator port (default: pick a free one)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    command = [c for c in args.command if c != "--"]
+    if args.launcher == "local":
+        rc = launch_local(args, command)
+    else:
+        if not args.hostfile:
+            ap.error("--launcher ssh needs --hostfile")
+        rc = launch_ssh(args, command)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
