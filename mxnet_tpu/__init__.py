@@ -18,6 +18,7 @@ from .context import Context, cpu, gpu, tpu, current_context, num_devices
 from .name import NameManager, AttrScope
 from . import amp
 from . import ops
+from . import operator
 from . import ndarray
 from . import ndarray as nd
 from . import random

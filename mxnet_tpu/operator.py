@@ -1,0 +1,226 @@
+"""Custom operators written in Python — the user escape hatch.
+
+Reference: ``python/mxnet/operator.py`` (``CustomOp:413``, ``CustomOpProp:480``,
+``register:593``) + ``src/operator/custom/custom-inl.h:50-69`` — user code
+defines forward/backward over NDArrays, a Prop class declares names/shapes,
+and ``register('op_type')`` makes ``mx.nd.Custom``/``mx.sym.Custom`` dispatch
+to it by ``op_type``.
+
+TPU design: the user's Python runs on the *host* via ``jax.pure_callback``
+(XLA cannot trace arbitrary Python), and the custom gradient plugs into the
+program as a ``jax.custom_vjp`` whose backward is a second host callback.
+The op integrates with everything built on the registry — Symbol graphs,
+Module's fused train step, Gluon blocks, autograd — because "Custom" is an
+ordinary registry op. This mirrors how the reference routes custom ops
+through the engine as opaque async ops (custom-inl.h Push), at the same
+cost model: a host round-trip per call, so use it for glue, not hot loops.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class"]
+
+_PROP_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp(object):
+    """Base class for custom operator implementations (reference:
+    python/mxnet/operator.py:413)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs from ``in_data`` into ``out_data`` via
+        :meth:`assign`."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into ``in_grad`` via :meth:`assign`."""
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor the gradient request when writing ``src`` to ``dst``
+        (reference: operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        elif req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError("invalid req %r" % req)
+
+
+class CustomOpProp(object):
+    """Declares a custom op's interface (reference: operator.py:480).
+
+    Subclass and override ``list_arguments``/``list_outputs``/
+    ``infer_shape``/``create_operator``. ``needs_top_grad`` says whether
+    backward consumes head gradients (False for loss-style ops).
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all inputs share in_shape[0]; every output too
+        (reference: operator.py CustomOpProp.infer_shape)."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self) -> bool:
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name: str):
+    """Class decorator: ``@mx.operator.register("my_op")`` on a CustomOpProp
+    subclass (reference: operator.py:593)."""
+
+    def _reg(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _reg
+
+
+def get_prop_class(op_type: str) -> type:
+    try:
+        return _PROP_REGISTRY[op_type]
+    except KeyError:
+        raise KeyError(
+            "custom op type %r not registered — decorate its CustomOpProp "
+            "with @mx.operator.register(%r)" % (op_type, op_type)) from None
+
+
+def _make_prop(op_type: str, attrs: Dict[str, Any]) -> CustomOpProp:
+    """Instantiate the Prop with the user attrs (the reference passes every
+    attr as a string kwarg, operator.py creator glue)."""
+    cls = get_prop_class(op_type)
+    kwargs = {k: v for k, v in attrs.items()
+              if not k.startswith("_") and k != "op_type"}
+    return cls(**kwargs)
+
+
+# --------------------------------------------------------------- registry op
+
+
+def _np_dtype(dt):
+    return np.dtype(dt)
+
+
+def _custom_impl(arrays, op_type, attrs, is_train):
+    import jax
+    from . import ndarray as nd
+
+    prop = _make_prop(op_type, attrs)
+    arg_names = prop.list_arguments()
+    out_names = prop.list_outputs()
+    if prop.list_auxiliary_states():
+        raise NotImplementedError(
+            "auxiliary states on custom ops are not supported yet")
+    if len(arrays) != len(arg_names):
+        raise ValueError(
+            "custom op %r expects %d inputs %s, got %d"
+            % (op_type, len(arg_names), arg_names, len(arrays)))
+
+    in_shapes = [tuple(int(d) for d in a.shape) for a in arrays]
+    ishapes, oshapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    itypes, otypes, _ = prop.infer_type([_np_dtype(a.dtype) for a in arrays])
+    out_avals = tuple(jax.ShapeDtypeStruct(tuple(s), _np_dtype(t))
+                      for s, t in zip(oshapes, otypes))
+    in_avals = tuple(jax.ShapeDtypeStruct(s, _np_dtype(a.dtype))
+                     for s, a in zip(in_shapes, arrays))
+    # one operator instance per call site, like the reference's per-executor
+    # instance (custom-inl.h CustomOperator); it lives across executions and
+    # may carry state
+    op_inst = prop.create_operator("cpu(0)", [list(s) for s in ishapes],
+                                   itypes)
+    n_in = len(arrays)
+
+    def host_forward(*xs):
+        in_data = [nd.array(np.asarray(x)) for x in xs]
+        out_data = [nd.NDArray(np.zeros(s, t))
+                    for s, t in zip(oshapes, otypes)]
+        op_inst.forward(is_train=is_train, req=["write"] * len(out_data),
+                        in_data=in_data, out_data=out_data, aux=[])
+        return tuple(o.asnumpy().astype(t, copy=False)
+                     for o, t in zip(out_data, otypes))
+
+    def host_backward(xs, outs, cts):
+        in_data = [nd.array(np.asarray(x)) for x in xs]
+        out_data = [nd.array(np.asarray(o)) for o in outs]
+        out_grad = [nd.array(np.asarray(c)) for c in cts] \
+            if prop.need_top_grad() else []
+        in_grad = [nd.NDArray(np.zeros(s, _np_dtype(a.dtype)))
+                   for s, a in zip(in_shapes, xs)]
+        op_inst.backward(req=["write"] * n_in, out_grad=out_grad,
+                         in_data=in_data, out_data=out_data,
+                         in_grad=in_grad, aux=[])
+        return tuple(g.asnumpy().astype(a.dtype, copy=False)
+                     for g, a in zip(in_grad, xs))
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(host_forward, out_avals, *xs)
+
+    def run_fwd(*xs):
+        outs = jax.pure_callback(host_forward, out_avals, *xs)
+        return outs, (xs, outs)
+
+    def run_bwd(res, cts):
+        xs, outs = res
+        return jax.pure_callback(host_backward, in_avals, xs, outs, cts)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*arrays)
+    return outs if len(outs) != 1 else outs[0]
+
+
+def _register_custom_op():
+    from .ops.registry import register as reg_op, get_op
+
+    @reg_op("Custom", num_inputs=None)
+    def custom(*arrays, op_type=None, _is_train=False, **attrs):
+        """Dispatch to a registered CustomOpProp by ``op_type`` (reference:
+        src/operator/custom/custom.cc + python/mxnet/operator.py glue)."""
+        if op_type is None:
+            raise ValueError("Custom op needs op_type=")
+        return _custom_impl(arrays, op_type, attrs, bool(_is_train))
+
+    def _prop_of(attrs):
+        if "op_type" not in attrs:
+            raise ValueError("Custom op needs op_type=")
+        return _make_prop(attrs["op_type"], attrs)
+
+    opdef = get_op("Custom")
+    opdef.num_outputs = lambda attrs: len(_prop_of(attrs).list_outputs())
+    opdef.input_names_fn = lambda attrs: list(_prop_of(attrs).list_arguments())
+
+
+_register_custom_op()
