@@ -1,13 +1,14 @@
 """RNN data iterators.
 
-Reference: ``python/mxnet/rnn/io.py`` — ``encode_sentences`` and
-``BucketSentenceIter`` (line 78): buckets sentences by padded length so each
-bucket is ONE static shape. On TPU this is exactly the bounded-jit-cache
-strategy (SURVEY.md §7 bucketing): one XLA executable per bucket.
+Reference surface: ``python/mxnet/rnn/io.py`` — ``encode_sentences`` and
+``BucketSentenceIter:78``. Bucketing pads each sentence to the smallest
+bucket length that fits, so every bucket is ONE static shape — on TPU that
+is precisely the bounded-jit-cache strategy (SURVEY.md §7): one cached XLA
+executable per bucket, picked by ``DataBatch.bucket_key``.
 """
 from __future__ import annotations
 
-import random
+import logging
 
 import numpy as np
 
@@ -19,56 +20,75 @@ __all__ = ["encode_sentences", "BucketSentenceIter"]
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0):
-    """Encode sentences to int arrays, building a vocab (reference:
-    io.py encode_sentences)."""
-    idx = start_label
+    """Map token sequences to integer id lists, optionally growing a fresh
+    vocab (reference: rnn/io.py encode_sentences)."""
     if vocab is None:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
+        frozen = False
     else:
-        new_vocab = False
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, "Unknown token %s" % word
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+        frozen = True
+    next_id = start_label
+    encoded = []
+    for sentence in sentences:
+        ids = []
+        for token in sentence:
+            if token not in vocab:
+                if frozen:
+                    raise AssertionError("Unknown token %s" % token)
+                if next_id == invalid_label:
+                    next_id += 1
+                vocab[token] = next_id
+                next_id += 1
+            ids.append(vocab[token])
+        encoded.append(ids)
+    return encoded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """Bucketed iterator for variable-length sequences (reference:
-    io.py:78 BucketSentenceIter)."""
+    """Bucketed iterator over variable-length id sequences (reference:
+    rnn/io.py:78 BucketSentenceIter).
+
+    Labels are the next-token shift of the data (language-model targets),
+    built once at construction; ``reset`` only reshuffles. Pass ``seed``
+    for a deterministic epoch order (an addition over the reference, which
+    used the process-global RNG).
+    """
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name="data", label_name="softmax_label", dtype="float32",
-                 layout="NT"):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT", seed=None):
         super().__init__()
+        lengths = np.array([len(s) for s in sentences])
         if not buckets:
-            buckets = [i for i, j in enumerate(np.bincount(
-                [len(s) for s in sentences])) if j >= batch_size]
-        buckets.sort()
+            # auto-buckets: every sentence length that appears at least
+            # batch_size times can sustain full batches of its own shape
+            counts = np.bincount(lengths)
+            buckets = [int(l) for l in np.nonzero(counts >= batch_size)[0]]
+        buckets = sorted(buckets)
+        if not buckets:
+            raise ValueError("no buckets: pass buckets= explicitly or use a "
+                             "smaller batch_size")
 
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = np.searchsorted(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
-        if ndiscard:
-            print("WARNING: discarded %d sentences longer than the largest "
-                  "bucket." % ndiscard)
+        # vectorized placement: smallest bucket that fits, else discard
+        slot = np.searchsorted(buckets, lengths)
+        n_discard = int(np.sum(slot == len(buckets)))
+        if n_discard:
+            logging.warning(
+                "BucketSentenceIter: %d sentences longer than the largest "
+                "bucket (%d) were discarded", n_discard, buckets[-1])
+
+        # one padded (rows, bucket_len) matrix per bucket, labels shifted
+        self.data = []
+        self._labels = []
+        for b, blen in enumerate(buckets):
+            rows = [sentences[i] for i in np.nonzero(slot == b)[0]]
+            mat = np.full((len(rows), blen), invalid_label, dtype=dtype)
+            for r, sent in enumerate(rows):
+                mat[r, :len(sent)] = sent
+            lab = np.full_like(mat, invalid_label)
+            lab[:, :-1] = mat[:, 1:]
+            self.data.append(mat)
+            self._labels.append(lab)
 
         self.batch_size = batch_size
         self.buckets = buckets
@@ -76,69 +96,55 @@ class BucketSentenceIter(DataIter):
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
+        self.layout = layout
+        self.major_axis = layout.find("N")
+        if self.major_axis not in (0, 1):
+            raise ValueError("layout must be 'NT' (batch-major) or 'TN' "
+                             "(time-major), got %r" % layout)
+        self.default_bucket_key = max(buckets)
+        self._rng = np.random.RandomState(seed)
+
+        shape = (batch_size, self.default_bucket_key)
+        if self.major_axis == 1:
+            shape = shape[::-1]
+        self.provide_data = [DataDesc(data_name, shape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, layout=layout)]
+
+        # (bucket, row-offset) of every full batch; partial tails dropped
+        self.idx = [(b, start)
+                    for b, mat in enumerate(self.data)
+                    for start in range(0, len(mat) - batch_size + 1,
+                                       batch_size)]
         self.nddata = []
         self.ndlabel = []
-        self.major_axis = layout.find("N")
-        self.default_bucket_key = max(buckets)
-
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(
-                data_name, (batch_size, self.default_bucket_key),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                label_name, (batch_size, self.default_bucket_key),
-                layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(
-                data_name, (self.default_bucket_key, batch_size),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                label_name, (self.default_bucket_key, batch_size),
-                layout=layout)]
-        else:
-            raise ValueError("Invalid layout %s: Must by NT (batch major) "
-                             "or TN (time major)" % layout)
-
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1,
-                                   batch_size)])
         self.curr_idx = 0
         self.reset()
 
     def reset(self):
         self.curr_idx = 0
-        random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-
+        self._rng.shuffle(self.idx)
         self.nddata = []
         self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(nd.array(buck, dtype=self.dtype))
-            self.ndlabel.append(nd.array(label, dtype=self.dtype))
+        for mat, lab in zip(self.data, self._labels):
+            perm = self._rng.permutation(len(mat))
+            mat[:] = mat[perm]
+            lab[:] = lab[perm]
+            self.nddata.append(nd.array(mat, dtype=self.dtype))
+            self.ndlabel.append(nd.array(lab, dtype=self.dtype))
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self.curr_idx >= len(self.idx):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
+        b, start = self.idx[self.curr_idx]
         self.curr_idx += 1
-
-        if self.major_axis == 1:
-            data = nd.transpose(self.nddata[i][j:j + self.batch_size])
-            label = nd.transpose(self.ndlabel[i][j:j + self.batch_size])
-        else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
-
-        batch = DataBatch([data], [label], pad=0,
-                          bucket_key=self.buckets[i],
-                          provide_data=[DataDesc(
-                              self.data_name, data.shape)],
-                          provide_label=[DataDesc(
-                              self.label_name, label.shape)])
-        return batch
+        data = self.nddata[b][start:start + self.batch_size]
+        label = self.ndlabel[b][start:start + self.batch_size]
+        if self.major_axis == 1:       # time-major: (T, N)
+            data = nd.transpose(data)
+            label = nd.transpose(label)
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[b],
+            provide_data=[DataDesc(self.data_name, data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, label.shape,
+                                    layout=self.layout)])

@@ -1,59 +1,106 @@
 """Symbolic RNN cells.
 
-Reference: ``python/mxnet/rnn/rnn_cell.py`` — ``BaseRNNCell`` (line 108)
-with begin_state/unroll over Symbols, ``RNNCell:362``, ``LSTMCell:408``,
-``GRUCell:469``, ``FusedRNNCell:536`` (maps to the fused RNN op; ``unfuse()``
-expands back to unrolled cells), modifier cells at 827-998.
+Reference surface: ``python/mxnet/rnn/rnn_cell.py`` — ``BaseRNNCell:108``,
+``RNNCell:362``, ``LSTMCell:408``, ``GRUCell:469``, ``FusedRNNCell:536``,
+modifier cells at 827-998. Parameter *names* (``<prefix>i2h_weight`` etc.),
+gate orders and state layouts match the reference so checkpoints and
+``unpack_weights`` round-trips stay compatible.
+
+TPU-first design notes:
+
+* ``FusedRNNCell`` maps onto the framework's fused RNN op (one ``lax.scan``
+  per layer, gate matmuls on the MXU — ops/rnn_op.py), so unlike the
+  reference's cuDNN-only fused path it runs on every backend.
+* Per-step cells build symbol graphs; under ``BucketingModule`` each bucket
+  length becomes one cached XLA executable (SURVEY.md §7).
+* The packed-parameter layout is described ONCE by :func:`_packed_segments`;
+  slicing, packing and size checks all walk that generator, so the cuDNN
+  layout convention lives in a single place.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from .. import symbol
-from ..base import MXNetError
 from .. import initializer as init_mod
-from ..name import NameManager
 from ..ops.rnn_op import rnn_param_size
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
            "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
            "BidirectionalCell", "RNNParams"]
 
+# gate-name suffixes per mode, in the packed (cuDNN) order
+_GATES = {"rnn_relu": ("",), "rnn_tanh": ("",),
+          "lstm": ("_i", "_f", "_c", "_o"), "gru": ("_r", "_z", "_o")}
+
+_MODIFIED_ERR = ("this cell has been wrapped by a modifier (Dropout/Zoneout/"
+                 "Residual); drive the modifier, not the wrapped cell")
+
 
 class RNNParams(object):
-    """Container for hold-and-share of cell weights (reference:
-    rnn_cell.py:78 RNNParams)."""
+    """Lazily-created, shareable weight Variables (reference:
+    rnn_cell.py:78). Two cells given the same RNNParams share weights."""
 
     def __init__(self, prefix=""):
         self._prefix = prefix
         self._params = {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        try:
+            return self._params[full]
+        except KeyError:
+            v = symbol.Variable(full, **kwargs)
+            self._params[full] = v
+            return v
+
+
+def _as_step_inputs(inputs, length, layout, input_prefix=""):
+    """Normalize unroll() input forms to a per-step symbol list.
+
+    Accepts None (auto Variables), one [N,T,C]/[T,N,C] symbol (split on the
+    time axis), or an explicit list of per-step symbols.
+    """
+    if inputs is None:
+        return [symbol.Variable("%st%d_data" % (input_prefix, t))
+                for t in range(length)]
+    if isinstance(inputs, symbol.Symbol):
+        if len(inputs.list_outputs()) != 1:
+            raise ValueError(
+                "unroll needs a single-output symbol to split over time; "
+                "pass a list of per-step symbols instead")
+        t_axis = layout.find("T")
+        return list(symbol.SliceChannel(inputs, axis=t_axis,
+                                        num_outputs=length, squeeze_axis=1))
+    inputs = list(inputs)
+    if len(inputs) != length:
+        raise ValueError("unroll got %d inputs for length %d"
+                         % (len(inputs), length))
+    return inputs
+
+
+def _merge_time(outputs):
+    """Stack per-step outputs into one [N, T, C] symbol."""
+    return symbol.Concat(*[symbol.expand_dims(o, axis=1) for o in outputs],
+                         dim=1)
 
 
 class BaseRNNCell(object):
-    """(reference: rnn_cell.py:108 BaseRNNCell)."""
+    """Stepping/unrolling interface shared by every cell (reference:
+    rnn_cell.py:108)."""
 
     def __init__(self, prefix="", params=None):
-        if params is None:
-            params = RNNParams(prefix)
-            self._own_params = True
-        else:
-            self._own_params = False
         self._prefix = prefix
-        self._params = params
+        self._own_params = params is None
+        self._params = RNNParams(prefix) if params is None else params
         self._modified = False
         self.reset()
 
     def reset(self):
+        """Forget step counters so the cell can build a fresh graph."""
         self._init_counter = -1
         self._counter = -1
 
     def __call__(self, inputs, states):
+        """One time step: (input symbol, state symbols) -> (output, states)."""
         raise NotImplementedError
 
     @property
@@ -63,119 +110,105 @@ class BaseRNNCell(object):
 
     @property
     def state_info(self):
+        """Per-state dicts: shape (0 = batch wildcard) and layout."""
         raise NotImplementedError
 
     @property
     def state_shape(self):
-        return [ele["shape"] for ele in self.state_info]
+        return [info["shape"] for info in self.state_info]
 
     @property
     def _gate_names(self):
         return ()
 
     def begin_state(self, func=symbol.Variable, **kwargs):
-        """(reference: rnn_cell.py begin_state)."""
-        assert not self._modified, \
-            "After applying modifier cells (e.g. DropoutCell) the base " \
-            "cell cannot be called directly. Call the modifier cell instead."
+        """Create initial-state symbols (reference: rnn_cell.py begin_state).
+        With the default func they are zero-initialized Variables whose batch
+        dim resolves at bind time."""
+        if self._modified:
+            raise AssertionError(_MODIFIED_ERR)
         states = []
         for info in self.state_info:
             self._init_counter += 1
             name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
             if func is symbol.Variable:
-                kw = {}
-                if info:
-                    if info.get("shape"):
-                        kw["shape"] = info["shape"]
-                    if info.get("__layout__"):
-                        kw["__layout__"] = info["__layout__"]
-                # zero initial state; the wildcard (0) batch dim resolves at
-                # bind time from the data batch (symbol.py _infer_shapes)
-                state = func(name, init=init_mod.Zero(), **kw)
+                kw = {k: info[k] for k in ("shape", "__layout__")
+                      if info and info.get(k)}
+                states.append(func(name, init=init_mod.Zero(), **kw))
             else:
-                state = func(name=name, **(info or {}))
-            states.append(state)
+                states.append(func(name=name, **(info or {})))
         return states
 
+    # --- packed <-> per-gate weight views -------------------------------
+    def _gate_param_names(self, group):
+        return [("%s%s%s_weight" % (self._prefix, group, g),
+                 "%s%s%s_bias" % (self._prefix, group, g))
+                for g in self._gate_names]
+
     def unpack_weights(self, args):
-        """Split packed fused weights into per-gate entries (reference:
-        rnn_cell.py unpack_weights)."""
+        """Explode fused i2h/h2h tensors into per-gate entries (reference:
+        rnn_cell.py unpack_weights; inverse of :meth:`pack_weights`)."""
         args = dict(args)
         if not self._gate_names:
             return args
         h = self._num_hidden
-        for group_name in ("i2h", "h2h"):
-            weight = args.pop("%s%s_weight" % (self._prefix, group_name))
-            bias = args.pop("%s%s_bias" % (self._prefix, group_name))
-            for j, gate in enumerate(self._gate_names):
-                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
-                args[wname] = weight[j * h:(j + 1) * h].copy()
-                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
-                args[bname] = bias[j * h:(j + 1) * h].copy()
+        for group in ("i2h", "h2h"):
+            w = args.pop("%s%s_weight" % (self._prefix, group))
+            b = args.pop("%s%s_bias" % (self._prefix, group))
+            for j, (wname, bname) in enumerate(self._gate_param_names(group)):
+                args[wname] = w[j * h:(j + 1) * h].copy()
+                args[bname] = b[j * h:(j + 1) * h].copy()
         return args
 
     def pack_weights(self, args):
-        """(reference: rnn_cell.py pack_weights)."""
+        """Concatenate per-gate entries back into fused tensors."""
         from .. import ndarray as nd
         args = dict(args)
         if not self._gate_names:
             return args
-        for group_name in ("i2h", "h2h"):
-            weight = []
-            bias = []
-            for gate in self._gate_names:
-                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
-                weight.append(args.pop(wname))
-                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
-                bias.append(args.pop(bname))
-            args["%s%s_weight" % (self._prefix, group_name)] = \
-                nd.concatenate(weight)
-            args["%s%s_bias" % (self._prefix, group_name)] = \
-                nd.concatenate(bias)
+        for group in ("i2h", "h2h"):
+            names = self._gate_param_names(group)
+            args["%s%s_weight" % (self._prefix, group)] = \
+                nd.concatenate([args.pop(w) for w, _ in names])
+            args["%s%s_bias" % (self._prefix, group)] = \
+                nd.concatenate([args.pop(b) for _, b in names])
         return args
 
     def unroll(self, length, inputs=None, begin_state=None,
                input_prefix="", layout="NTC", merge_outputs=None):
-        """Unroll into a symbol graph (reference: rnn_cell.py unroll)."""
+        """Unroll `length` steps into a symbol graph (reference:
+        rnn_cell.py unroll)."""
         self.reset()
-        if inputs is None:
-            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
-        elif isinstance(inputs, symbol.Symbol):
-            assert len(inputs.list_outputs()) == 1, \
-                "unroll doesn't allow grouped symbol as input. Please " \
-                "convert to list first or let unroll handle splitting"
-            axis = layout.find("T")
-            inputs = list(symbol.SliceChannel(
-                inputs, axis=axis, num_outputs=length, squeeze_axis=1))
-        else:
-            assert len(inputs) == length
-        if begin_state is None:
-            begin_state = self.begin_state()
-
-        states = begin_state
+        inputs = _as_step_inputs(inputs, length, layout, input_prefix)
+        states = begin_state if begin_state is not None else \
+            self.begin_state()
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        for t in range(length):
+            out, states = self(inputs[t], states)
+            outputs.append(out)
         if merge_outputs:
-            outputs = [symbol.expand_dims(i, axis=1) for i in outputs]
-            outputs = symbol.Concat(*outputs, dim=1)
+            outputs = _merge_time(outputs)
         return outputs, states
 
 
+def _linear(name, data, weight, bias, num_hidden):
+    """Gate projection: one FullyConnected hitting the MXU."""
+    return symbol.FullyConnected(data=data, weight=weight, bias=bias,
+                                 num_hidden=num_hidden, name=name)
+
+
 class RNNCell(BaseRNNCell):
-    """(reference: rnn_cell.py:362)."""
+    """Vanilla Elman cell: h' = act(W_i x + W_h h) (reference:
+    rnn_cell.py:362)."""
 
     def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
                  params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
         self._activation = activation
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        p = self.params
+        self._iW, self._iB = p.get("i2h_weight"), p.get("i2h_bias")
+        self._hW, self._hB = p.get("h2h_weight"), p.get("h2h_bias")
 
     @property
     def state_info(self):
@@ -187,33 +220,31 @@ class RNNCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden,
-                                    name="%sh2h" % name)
-        output = symbol.Activation(i2h + h2h, act_type=self._activation,
-                                   name="%sout" % name)
-        return output, [output]
+        n = "%st%d_" % (self._prefix, self._counter)
+        pre = _linear(n + "i2h", inputs, self._iW, self._iB,
+                      self._num_hidden) \
+            + _linear(n + "h2h", states[0], self._hW, self._hB,
+                      self._num_hidden)
+        out = symbol.Activation(pre, act_type=self._activation,
+                                name=n + "out")
+        return out, [out]
 
 
 class LSTMCell(BaseRNNCell):
-    """(reference: rnn_cell.py:408). Gate order i,f,c,o."""
+    """LSTM, gate order i,f,c,o (reference: rnn_cell.py:408)."""
 
     def __init__(self, num_hidden, prefix="lstm_", params=None,
                  forget_bias=1.0):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._hW = self.params.get("h2h_weight")
-        self._iB = self.params.get(
-            "i2h_bias",
-            init=init_mod.LSTMBias(forget_bias=forget_bias))
-        self._hB = self.params.get("h2h_bias")
+        p = self.params
+        self._iW = p.get("i2h_weight")
+        self._hW = p.get("h2h_weight")
+        # forget-gate bias offset lives in the initializer so a fresh model
+        # starts remembering (reference: LSTMBias)
+        self._iB = p.get("i2h_bias",
+                         init=init_mod.LSTMBias(forget_bias=forget_bias))
+        self._hB = p.get("h2h_bias")
 
     @property
     def state_info(self):
@@ -226,42 +257,30 @@ class LSTMCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%sh2h" % name)
-        gates = i2h + h2h
-        slice_gates = symbol.SliceChannel(gates, num_outputs=4,
-                                          name="%sslice" % name)
-        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
-                                    name="%si" % name)
-        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
-                                        name="%sf" % name)
-        in_transform = symbol.Activation(slice_gates[2], act_type="tanh",
-                                         name="%sc" % name)
-        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
-                                     name="%so" % name)
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * symbol.Activation(next_c, act_type="tanh",
-                                              name="%sstate" % name)
-        return next_h, [next_h, next_c]
+        n = "%st%d_" % (self._prefix, self._counter)
+        h = self._num_hidden
+        pre = _linear(n + "i2h", inputs, self._iW, self._iB, 4 * h) \
+            + _linear(n + "h2h", states[0], self._hW, self._hB, 4 * h)
+        gi, gf, gc, go = symbol.SliceChannel(pre, num_outputs=4,
+                                             name=n + "slice")
+        i = symbol.Activation(gi, act_type="sigmoid", name=n + "i")
+        f = symbol.Activation(gf, act_type="sigmoid", name=n + "f")
+        c_tilde = symbol.Activation(gc, act_type="tanh", name=n + "c")
+        o = symbol.Activation(go, act_type="sigmoid", name=n + "o")
+        c = f * states[1] + i * c_tilde
+        h_out = o * symbol.Activation(c, act_type="tanh", name=n + "state")
+        return h_out, [h_out, c]
 
 
 class GRUCell(BaseRNNCell):
-    """(reference: rnn_cell.py:469). Gate order r,z,o."""
+    """GRU, gate order r,z,o (reference: rnn_cell.py:469)."""
 
     def __init__(self, num_hidden, prefix="gru_", params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        p = self.params
+        self._iW, self._iB = p.get("i2h_weight"), p.get("i2h_bias")
+        self._hW, self._hB = p.get("h2h_weight"), p.get("h2h_bias")
 
     @property
     def state_info(self):
@@ -273,42 +292,37 @@ class GRUCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        prev_state_h = states[0]
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%sh2h" % name)
-        i2h_r, i2h_z, i2h = symbol.SliceChannel(
-            i2h, num_outputs=3, name="%si2h_slice" % name)
-        h2h_r, h2h_z, h2h = symbol.SliceChannel(
-            h2h, num_outputs=3, name="%sh2h_slice" % name)
-        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
-                                       name="%sr_act" % name)
-        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
-                                        name="%sz_act" % name)
-        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
-                                       act_type="tanh",
-                                       name="%sh_act" % name)
-        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_state_h
-        return next_h, [next_h]
+        n = "%st%d_" % (self._prefix, self._counter)
+        h_prev = states[0]
+        xi = _linear(n + "i2h", inputs, self._iW, self._iB,
+                     3 * self._num_hidden)
+        hi = _linear(n + "h2h", h_prev, self._hW, self._hB,
+                     3 * self._num_hidden)
+        xr, xz, xn = symbol.SliceChannel(xi, num_outputs=3,
+                                         name=n + "i2h_slice")
+        hr, hz, hn = symbol.SliceChannel(hi, num_outputs=3,
+                                         name=n + "h2h_slice")
+        r = symbol.Activation(xr + hr, act_type="sigmoid", name=n + "r_act")
+        z = symbol.Activation(xz + hz, act_type="sigmoid", name=n + "z_act")
+        cand = symbol.Activation(xn + r * hn, act_type="tanh",
+                                 name=n + "h_act")
+        h_new = (1.0 - z) * cand + z * h_prev
+        return h_new, [h_new]
 
 
 class FusedRNNCell(BaseRNNCell):
-    """Fused multi-layer cell over the RNN op (reference: rnn_cell.py:536
-    FusedRNNCell — cuDNN there, lax.scan here, so it runs on every
-    backend)."""
+    """Multi-layer fused cell over the RNN op (reference: rnn_cell.py:536).
+
+    The reference's fused path is cuDNN-only; here it lowers to one
+    ``lax.scan`` per layer/direction (ops/rnn_op.py) and runs everywhere.
+    All weights live in ONE packed Variable in the cuDNN layout.
+    """
 
     def __init__(self, num_hidden, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, get_next_state=False,
                  forget_bias=1.0, prefix=None, params=None):
-        if prefix is None:
-            prefix = "%s_" % mode
-        super().__init__(prefix=prefix, params=params)
+        super().__init__(prefix="%s_" % mode if prefix is None else prefix,
+                         params=params)
         self._num_hidden = num_hidden
         self._num_layers = num_layers
         self._mode = mode
@@ -316,194 +330,168 @@ class FusedRNNCell(BaseRNNCell):
         self._dropout = dropout
         self._get_next_state = get_next_state
         self._directions = ["l", "r"] if bidirectional else ["l"]
-        initializer = init_mod.FusedRNN(
-            None, num_hidden, num_layers, mode, bidirectional, forget_bias)
-        self._parameter = self.params.get("parameters", init=initializer)
+        self._parameter = self.params.get(
+            "parameters", init=init_mod.FusedRNN(
+                None, num_hidden, num_layers, mode, bidirectional,
+                forget_bias))
 
     @property
     def state_info(self):
-        b = self._num_layers * (2 if self._bidirectional else 1)
-        n = 2 if self._mode == "lstm" else 1
-        return [{"shape": (b, 0, self._num_hidden),
-                 "__layout__": "LNC"}] * n
+        first = self._num_layers * len(self._directions)
+        n_states = 2 if self._mode == "lstm" else 1
+        return [{"shape": (first, 0, self._num_hidden),
+                 "__layout__": "LNC"}] * n_states
 
     @property
     def _gate_names(self):
-        return {"rnn_relu": [""], "rnn_tanh": [""],
-                "lstm": ["_i", "_f", "_c", "_o"],
-                "gru": ["_r", "_z", "_o"]}[self._mode]
+        return list(_GATES[self._mode])
 
     @property
     def _num_gates(self):
-        return len(self._gate_names)
+        return len(_GATES[self._mode])
 
-    def _slice_weights(self, arr, li, lh):
-        """Map the packed vector to per-layer cell names (reference:
-        rnn_cell.py _slice_weights)."""
-        args = {}
-        gate_names = self._gate_names
-        directions = self._directions
-        b = len(directions)
-        p = 0
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for group_name in ("i2h", "h2h"):
-                    ni = li if group_name == "i2h" else lh
-                    if layer > 0 and group_name == "i2h":
-                        ni = b * lh
-                    size = lh * ni * self._num_gates
-                    w = arr[p:p + size].reshape(
-                        (lh * self._num_gates, ni))
-                    for j, gate in enumerate(gate_names):
-                        name = "%s%s%d_%s%s_weight" % (
-                            self._prefix, direction, layer, group_name, gate)
-                        args[name] = w[j * lh:(j + 1) * lh].copy()
-                    p += size
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for group_name in ("i2h", "h2h"):
-                    size = lh * self._num_gates
-                    bias = arr[p:p + size]
-                    for j, gate in enumerate(gate_names):
-                        name = "%s%s%d_%s%s_bias" % (
-                            self._prefix, direction, layer, group_name, gate)
-                        args[name] = bias[j * lh:(j + 1) * lh].copy()
-                    p += size
-        assert p == arr.size, "Invalid parameters size for FusedRNNCell"
-        return args
+    # --- packed layout: the single source of truth ----------------------
+    def _packed_segments(self, input_size):
+        """Yield ``(kind, name, rows, cols)`` for every segment of the packed
+        vector in order — weights for all layers/directions first, then
+        biases (the fused op's cuDNN-style convention, ops/rnn_op.py
+        rnn_unpack_params). ``name`` is the per-gate parameter name."""
+        h = self._num_hidden
+        ndir = len(self._directions)
+        for section in ("weight", "bias"):
+            for layer in range(self._num_layers):
+                in_sz = input_size if layer == 0 else h * ndir
+                for d in self._directions:
+                    for group, cols in (("i2h", in_sz), ("h2h", h)):
+                        for gate in _GATES[self._mode]:
+                            name = "%s%s%d_%s%s_%s" % (
+                                self._prefix, d, layer, group, gate, section)
+                            if section == "weight":
+                                yield ("weight", name, h, cols)
+                            else:
+                                yield ("bias", name, h, 1)
+
+    def _solve_input_size(self, total):
+        """Invert rnn_param_size for the layer-0 input width."""
+        h, g = self._num_hidden, self._num_gates
+        ndir = len(self._directions)
+        deeper = sum(ndir * g * h * (h * ndir + h + 2)
+                     for _ in range(self._num_layers - 1))
+        return (total - deeper) // (ndir * g * h) - h - 2
 
     def unpack_weights(self, args):
         args = dict(args)
-        arr = args.pop("%sparameters" % self._prefix)
-
-        input_size = self._input_size_from(arr)
-        args.update(self._slice_weights(arr, input_size, self._num_hidden))
+        packed = args.pop("%sparameters" % self._prefix)
+        in_sz = self._solve_input_size(packed.size)
+        pos = 0
+        for kind, name, rows, cols in self._packed_segments(in_sz):
+            n = rows * cols
+            seg = packed[pos:pos + n]
+            args[name] = (seg.reshape((rows, cols)) if kind == "weight"
+                          else seg).copy()
+            pos += n
+        if pos != packed.size:
+            raise ValueError(
+                "packed parameter vector has %d values; layout expects %d"
+                % (packed.size, pos))
         return args
 
     def pack_weights(self, args):
         from .. import ndarray as nd
         args = dict(args)
         w0 = args["%sl0_i2h%s_weight" % (self._prefix, self._gate_names[0])]
-        input_size = w0.shape[1]
-        arr = nd.zeros((rnn_param_size(self._num_layers, input_size,
-                                       self._num_hidden, self._mode,
-                                       self._bidirectional),),
-                       dtype=w0.dtype)
-        shapes = self._slice_weights(arr, input_size, self._num_hidden)
-        # write values back in packed order
-        from .. import ndarray as _nd
-        chunks = []
-        b = len(self._directions)
-        lh = self._num_hidden
-        for layer in range(self._num_layers):
-            for direction in self._directions:
-                for group_name in ("i2h", "h2h"):
-                    for gate in self._gate_names:
-                        name = "%s%s%d_%s%s_weight" % (
-                            self._prefix, direction, layer, group_name, gate)
-                        chunks.append(_nd.reshape(args.pop(name), (-1,)))
-        for layer in range(self._num_layers):
-            for direction in self._directions:
-                for group_name in ("i2h", "h2h"):
-                    for gate in self._gate_names:
-                        name = "%s%s%d_%s%s_bias" % (
-                            self._prefix, direction, layer, group_name, gate)
-                        chunks.append(args.pop(name))
-        args["%sparameters" % self._prefix] = _nd.concatenate(chunks)
+        in_sz = w0.shape[1]
+        chunks = [nd.reshape(args.pop(name), (-1,))
+                  for _, name, _, _ in self._packed_segments(in_sz)]
+        packed = nd.concatenate(chunks)
+        expect = rnn_param_size(self._num_layers, in_sz, self._num_hidden,
+                                self._mode, self._bidirectional)
+        if packed.size != expect:
+            raise ValueError("packed %d values, layout expects %d"
+                             % (packed.size, expect))
+        args["%sparameters" % self._prefix] = packed
         return args
-
-    def _input_size_from(self, arr):
-        """Solve for the input size given the packed array length."""
-        gates = self._num_gates
-        b = len(self._directions)
-        lh = self._num_hidden
-        L = self._num_layers
-        total = arr.size
-        # total = b*gates*lh*(I + lh + 2) + (L-1)*b*gates*lh*(b*lh + lh + 2)
-        rest = (L - 1) * b * gates * lh * (b * lh + lh + 2)
-        first = total - rest
-        return first // (b * gates * lh) - lh - 2
 
     def __call__(self, inputs, states):
         raise NotImplementedError(
-            "FusedRNNCell cannot be stepped. Please use unroll")
+            "the fused cell is a whole-sequence op; use unroll() (or "
+            "unfuse() for a steppable stack)")
 
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
-        """One fused RNN op instead of an unrolled graph (reference:
-        rnn_cell.py FusedRNNCell.unroll)."""
+        """Emit ONE fused RNN op instead of a per-step graph."""
         self.reset()
-        axis = layout.find("T")
-        if inputs is None:
+        batch_major = layout.find("T") == 1
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != length:
+                raise ValueError("unroll got %d inputs for length %d"
+                                 % (len(inputs), length))
+            inputs = _merge_time(list(inputs))
+            batch_major = True
+        elif inputs is None:
             inputs = symbol.Variable("%sdata" % input_prefix)
-        elif isinstance(inputs, (list, tuple)):
-            assert len(inputs) == length
-            inputs = [symbol.expand_dims(i, axis=1) for i in inputs]
-            inputs = symbol.Concat(*inputs, dim=1)
-            axis = 1
-        if axis == 1:  # NTC -> TNC
-            inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
+        if batch_major:
+            inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)  # -> TNC
+
         if begin_state is None:
             begin_state = self.begin_state(
                 func=lambda name, **kw: symbol.Variable(name))
-
-        states = begin_state
+        state_kw = {"state": begin_state[0]}
         if self._mode == "lstm":
-            states = {"state": states[0], "state_cell": states[1]}
-        else:
-            states = {"state": states[0]}
+            state_kw["state_cell"] = begin_state[1]
 
-        rnn = symbol.RNN(data=inputs, parameters=self._parameter,
+        out = symbol.RNN(data=inputs, parameters=self._parameter,
                          state_size=self._num_hidden,
                          num_layers=self._num_layers,
                          bidirectional=self._bidirectional, p=self._dropout,
                          state_outputs=self._get_next_state,
                          mode=self._mode, name=self._prefix + "rnn",
-                         **states)
+                         **state_kw)
 
-        attr = {"num_outputs": 3 if self._mode == "lstm" else 2}
         if not self._get_next_state:
-            outputs, states = rnn, []
-        elif self._mode == "lstm":
-            outputs, states = rnn[0], [rnn[1], rnn[2]]
+            outputs, states = out, []
         else:
-            outputs, states = rnn[0], [rnn[1]]
-        if axis == 1:
+            outputs = out[0]
+            states = [out[1], out[2]] if self._mode == "lstm" else [out[1]]
+        if batch_major:
             outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
         if merge_outputs is False:
+            t_axis = 1 if batch_major else 0
             outputs = list(symbol.SliceChannel(
-                outputs, axis=axis, num_outputs=length, squeeze_axis=1))
+                outputs, axis=t_axis, num_outputs=length, squeeze_axis=1))
         return outputs, states
 
     def unfuse(self):
-        """Expand to a SequentialRNNCell of unrolled cells (reference:
+        """Equivalent steppable stack of unrolled cells (reference:
         rnn_cell.py unfuse)."""
-        stack = SequentialRNNCell()
-        get_cell = {
+        factories = {
             "rnn_relu": lambda pfx: RNNCell(self._num_hidden,
                                             activation="relu", prefix=pfx),
             "rnn_tanh": lambda pfx: RNNCell(self._num_hidden,
                                             activation="tanh", prefix=pfx),
             "lstm": lambda pfx: LSTMCell(self._num_hidden, prefix=pfx),
             "gru": lambda pfx: GRUCell(self._num_hidden, prefix=pfx),
-        }[self._mode]
-        for i in range(self._num_layers):
+        }
+        make = factories[self._mode]
+        stack = SequentialRNNCell()
+        for layer in range(self._num_layers):
             if self._bidirectional:
                 stack.add(BidirectionalCell(
-                    get_cell("%sl%d_" % (self._prefix, i)),
-                    get_cell("%sr%d_" % (self._prefix, i)),
-                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+                    make("%sl%d_" % (self._prefix, layer)),
+                    make("%sr%d_" % (self._prefix, layer)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, layer)))
             else:
-                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
-            if self._dropout > 0 and i != self._num_layers - 1:
-                stack.add(DropoutCell(self._dropout,
-                                      prefix="%s_dropout%d_"
-                                      % (self._prefix, i)))
+                stack.add(make("%sl%d_" % (self._prefix, layer)))
+            if self._dropout > 0 and layer + 1 < self._num_layers:
+                stack.add(DropoutCell(
+                    self._dropout,
+                    prefix="%s_dropout%d_" % (self._prefix, layer)))
         return stack
 
 
 class SequentialRNNCell(BaseRNNCell):
-    """(reference: rnn_cell.py SequentialRNNCell)."""
+    """Vertically stacked cells stepped together (reference: rnn_cell.py
+    SequentialRNNCell)."""
 
     def __init__(self, params=None):
         super().__init__(prefix="", params=params)
@@ -513,19 +501,20 @@ class SequentialRNNCell(BaseRNNCell):
     def add(self, cell):
         self._cells.append(cell)
         if self._override_cell_params:
-            assert cell._own_params, \
-                "Either specify params for SequentialRNNCell or child " \
-                "cells, not both."
+            if not cell._own_params:
+                raise AssertionError(
+                    "give params to the stack or to its cells, not both")
             cell.params._params.update(self.params._params)
         self.params._params.update(cell.params._params)
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return [info for c in self._cells for info in c.state_info]
 
     def begin_state(self, **kwargs):
-        assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        if self._modified:
+            raise AssertionError(_MODIFIED_ERR)
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
 
     def unpack_weights(self, args):
         for cell in self._cells:
@@ -539,16 +528,17 @@ class SequentialRNNCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
+        out_states = []
+        pos = 0
         for cell in self._cells:
-            assert not isinstance(cell, BidirectionalCell)
+            if isinstance(cell, BidirectionalCell):
+                raise TypeError("a bidirectional cell cannot be stepped "
+                                "inside a sequential stack; unroll it")
             n = len(cell.state_info)
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.extend(state)
-        return inputs, next_states
+            inputs, new_s = cell(inputs, states[pos:pos + n])
+            pos += n
+            out_states.extend(new_s)
+        return inputs, out_states
 
     def reset(self):
         super().reset()
@@ -557,7 +547,8 @@ class SequentialRNNCell(BaseRNNCell):
 
 
 class DropoutCell(BaseRNNCell):
-    """(reference: rnn_cell.py DropoutCell)."""
+    """Stateless dropout-on-output step (reference: rnn_cell.py
+    DropoutCell)."""
 
     def __init__(self, dropout, prefix="dropout_", params=None):
         super().__init__(prefix=prefix, params=params)
@@ -574,6 +565,9 @@ class DropoutCell(BaseRNNCell):
 
 
 class _ModifierCell(BaseRNNCell):
+    """Wraps a cell, delegating params/state; the wrapped cell is locked
+    against direct use (reference: rnn_cell.py ModifierCell)."""
+
     def __init__(self, base_cell):
         base_cell._modified = True
         super().__init__()
@@ -589,11 +583,13 @@ class _ModifierCell(BaseRNNCell):
         return self.base_cell.state_info
 
     def begin_state(self, func=symbol.Variable, **kwargs):
-        assert not self._modified
+        if self._modified:
+            raise AssertionError(_MODIFIED_ERR)
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func, **kwargs)
-        self.base_cell._modified = True
-        return begin
+        try:
+            return self.base_cell.begin_state(func=func, **kwargs)
+        finally:
+            self.base_cell._modified = True
 
     def unpack_weights(self, args):
         return self.base_cell.unpack_weights(args)
@@ -603,14 +599,16 @@ class _ModifierCell(BaseRNNCell):
 
 
 class ZoneoutCell(_ModifierCell):
-    """(reference: rnn_cell.py ZoneoutCell)."""
+    """Zoneout: randomly keep previous output/state (reference: rnn_cell.py
+    ZoneoutCell; paper arXiv:1606.01305)."""
 
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
-        assert not isinstance(base_cell, FusedRNNCell), \
-            "FusedRNNCell doesn't support zoneout. Use its unfuse() first."
-        assert not isinstance(base_cell, BidirectionalCell), \
-            "BidirectionalCell doesn't support zoneout since it doesn't " \
-            "support step. Please add ZoneoutCell to the cells underneath."
+        if isinstance(base_cell, FusedRNNCell):
+            raise TypeError("zoneout needs per-step access: unfuse() the "
+                            "fused cell first")
+        if isinstance(base_cell, BidirectionalCell):
+            raise TypeError("wrap the directional sub-cells with zoneout, "
+                            "not the bidirectional composite")
         super().__init__(base_cell)
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states
@@ -621,43 +619,50 @@ class ZoneoutCell(_ModifierCell):
         self.prev_output = None
 
     def __call__(self, inputs, states):
-        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
-                                     self.zoneout_states)
-        next_output, next_states = cell(inputs, states)
-        mask = lambda p, like: symbol.Dropout(  # noqa: E731
-            symbol.ones_like(like), p=p)
-        prev_output = self.prev_output if self.prev_output is not None \
-            else symbol.zeros_like(next_output)
-        output = symbol.where(mask(p_outputs, next_output), next_output,
-                              prev_output) if p_outputs != 0.0 \
-            else next_output
-        states = [symbol.where(mask(p_states, new_s), new_s, old_s)
-                  for new_s, old_s in zip(next_states, states)] \
-            if p_states != 0.0 else next_states
-        self.prev_output = output
-        return output, states
+        out, new_states = self.base_cell(inputs, states)
+
+        def keep_mask(p, like):
+            # Dropout of ones: 1/(1-p) with prob (1-p), else 0 — nonzero
+            # means "take the new value"
+            return symbol.Dropout(symbol.ones_like(like), p=p)
+
+        prev = self.prev_output if self.prev_output is not None \
+            else symbol.zeros_like(out)
+        if self.zoneout_outputs > 0.0:
+            out = symbol.where(keep_mask(self.zoneout_outputs, out),
+                               out, prev)
+        if self.zoneout_states > 0.0:
+            new_states = [
+                symbol.where(keep_mask(self.zoneout_states, s_new), s_new,
+                             s_old)
+                for s_new, s_old in zip(new_states, states)]
+        self.prev_output = out
+        return out, new_states
 
 
 class ResidualCell(_ModifierCell):
-    """(reference: rnn_cell.py ResidualCell)."""
+    """Adds the step input to the step output (reference: rnn_cell.py
+    ResidualCell)."""
 
     def __call__(self, inputs, states):
-        output, states = self.base_cell(inputs, states)
-        output = symbol.elemwise_add(output, inputs)
-        return output, states
+        out, states = self.base_cell(inputs, states)
+        return symbol.elemwise_add(out, inputs), states
 
 
 class BidirectionalCell(BaseRNNCell):
-    """(reference: rnn_cell.py BidirectionalCell)."""
+    """Runs one cell forward and one backward over the sequence,
+    concatenating outputs per step (reference: rnn_cell.py
+    BidirectionalCell)."""
 
     def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
         super().__init__("", params=params)
         self._output_prefix = output_prefix
         self._override_cell_params = params is not None
         if self._override_cell_params:
-            assert l_cell._own_params and r_cell._own_params, \
-                "Either specify params for BidirectionalCell or child " \
-                "cells, not both."
+            if not (l_cell._own_params and r_cell._own_params):
+                raise AssertionError(
+                    "give params to the bidirectional composite or to its "
+                    "sub-cells, not both")
             l_cell.params._params.update(self.params._params)
             r_cell.params._params.update(self.params._params)
         self.params._params.update(l_cell.params._params)
@@ -676,45 +681,36 @@ class BidirectionalCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         raise NotImplementedError(
-            "Bidirectional cannot be stepped. Please use unroll")
+            "a bidirectional cell consumes the whole sequence; use unroll()")
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return [info for c in self._cells for info in c.state_info]
 
     def begin_state(self, **kwargs):
-        assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        if self._modified:
+            raise AssertionError(_MODIFIED_ERR)
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
 
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
         self.reset()
-        if inputs is None:
-            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
-        elif isinstance(inputs, symbol.Symbol):
-            axis = layout.find("T")
-            inputs = list(symbol.SliceChannel(
-                inputs, axis=axis, num_outputs=length, squeeze_axis=1))
-        if begin_state is None:
-            begin_state = self.begin_state()
-
-        states = begin_state
-        l_cell, r_cell = self._cells
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info)],
-            layout=layout, merge_outputs=False)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info):],
-            layout=layout, merge_outputs=False)
-
-        outputs = [symbol.Concat(l_o, r_o, dim=1,
-                                 name="%st%d" % (self._output_prefix, i))
-                   for i, (l_o, r_o) in
-                   enumerate(zip(l_outputs, reversed(r_outputs)))]
+        inputs = _as_step_inputs(inputs, length, layout, input_prefix)
+        states = begin_state if begin_state is not None else \
+            self.begin_state()
+        fwd, bwd = self._cells
+        n_fwd = len(fwd.state_info)
+        f_out, f_states = fwd.unroll(length, inputs=inputs,
+                                     begin_state=states[:n_fwd],
+                                     layout=layout, merge_outputs=False)
+        b_out, b_states = bwd.unroll(length,
+                                     inputs=list(reversed(inputs)),
+                                     begin_state=states[n_fwd:],
+                                     layout=layout, merge_outputs=False)
+        outputs = [
+            symbol.Concat(f, b, dim=1,
+                          name="%st%d" % (self._output_prefix, t))
+            for t, (f, b) in enumerate(zip(f_out, reversed(b_out)))]
         if merge_outputs:
-            outputs = [symbol.expand_dims(i, axis=1) for i in outputs]
-            outputs = symbol.Concat(*outputs, dim=1)
-        return outputs, l_states + r_states
+            outputs = _merge_time(outputs)
+        return outputs, f_states + b_states

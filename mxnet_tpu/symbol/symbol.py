@@ -269,7 +269,10 @@ class Symbol:
             for n, s in zip(arg_names, args):
                 if s is not None:
                     known[n] = tuple(s)
+        batch_hint = kwargs.pop("__batch_size__", None)
         known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        if batch_hint is not None:
+            known["__batch_size__"] = int(batch_hint)
 
         # Variables whose shapes are derivable from graph structure get
         # resolved by abstract evaluation; others must be provided.
@@ -472,13 +475,22 @@ def make_symbol_function(op: OpDef):
         name = nm.get(name, op.name.lower().replace("_", ""))
 
         inputs: Dict[str, Symbol] = {}
-        if op.num_inputs is None and args and all(
+        # ops with attr-dependent interfaces (Custom: the Prop declares
+        # list_arguments) resolve their input names from the non-symbol kwargs
+        names_fn = getattr(op, "input_names_fn", None)
+        if names_fn is not None:
+            attr_kwargs = {k: v for k, v in kwargs.items()
+                           if not isinstance(v, Symbol)}
+            input_names_l = names_fn(attr_kwargs)
+        else:
+            input_names_l = input_names
+        if op.num_inputs is None and names_fn is None and args and all(
                 isinstance(a, Symbol) for a in args) and len(args) > 1 \
-                and not any(k in kwargs for k in input_names):
+                and not any(k in kwargs for k in input_names_l):
             # variadic (Concat-style): positional symbols are THE inputs
             attrs = {k: v for k, v in kwargs.items()}
             return _create(op, list(args), attrs, name)
-        for nm_i, a in zip(input_names, args):
+        for nm_i, a in zip(input_names_l, args):
             inputs[nm_i] = a
         attrs = {}
         for k, v in kwargs.items():
@@ -487,7 +499,7 @@ def make_symbol_function(op: OpDef):
             else:
                 attrs[k] = v
         in_syms = []
-        for nm_i in input_names:
+        for nm_i in input_names_l:
             if nm_i in inputs:
                 in_syms.append(inputs[nm_i])
             else:
@@ -674,6 +686,33 @@ def _derive_param_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]]):
                     lbl = (ds[0],) if opname in ("SoftmaxOutput", "SVMOutput") \
                         else ds
                     setvar(1, lbl)
+                elif opname == "RNN":
+                    # ds = (T, N, input); packed params + initial states
+                    from ..ops.rnn_op import rnn_param_size
+                    H = int(a.get("state_size"))
+                    L = int(a.get("num_layers", 1))
+                    mode = a.get("mode", "lstm")
+                    dirs = 2 if a.get("bidirectional") else 1
+                    setvar(1, (rnn_param_size(L, ds[2], H, mode,
+                                              bool(a.get("bidirectional"))),))
+                    setvar(2, (L * dirs, ds[1], H))
+                    if mode == "lstm":
+                        setvar(3, (L * dirs, ds[1], H))
+                elif opname == "Custom":
+                    # the user's Prop owns the shape rules; its infer_shape
+                    # may choke on partially-None shapes (user validation
+                    # code) — any failure just skips derivation for the node
+                    try:
+                        from ..operator import _make_prop
+                        prop = _make_prop(a["op_type"], a)
+                        ish, _, _ = prop.infer_shape(
+                            [list(s) if s is not None else None
+                             for s in in_shapes])
+                        for pos, s in enumerate(ish):
+                            if s is not None:
+                                setvar(pos, tuple(int(x) for x in s))
+                    except Exception:
+                        pass
         except (TypeError, KeyError, ValueError):
             pass
 

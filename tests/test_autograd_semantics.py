@@ -145,6 +145,9 @@ def test_custom_function():
 
 def test_tape_pruned_on_new_record_scope():
     from mxnet_tpu.autograd import _st
+    # isolate from entries other tests' live arrays legitimately keep on
+    # the process-global tape (this asserts pruning, not global cleanliness)
+    _st().tape.clear()
     a = mx.nd.array([1.0])
     a.attach_grad()
     for _ in range(5):

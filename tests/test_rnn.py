@@ -1,0 +1,130 @@
+"""Symbolic RNN cells + bucketed iterator (reference test pattern:
+tests/python/unittest/test_rnn.py — fused/unfused consistency,
+pack/unpack round-trip, unroll shapes)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.rnn import (BucketSentenceIter, BidirectionalCell,
+                           FusedRNNCell, GRUCell, LSTMCell, RNNCell,
+                           SequentialRNNCell, ResidualCell, encode_sentences)
+
+
+def _run_sym(sym, shapes, seed=0):
+    ex = sym.simple_bind(ctx=mx.cpu(), **shapes)
+    rng = np.random.RandomState(seed)
+    for name, arr in ex.arg_dict.items():
+        if "begin_state" in name:
+            arr[:] = 0.0
+        else:
+            arr[:] = rng.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+    return ex, {k: v.asnumpy() for k, v in ex.arg_dict.items()}
+
+
+def test_rnn_cell_unroll_shapes():
+    for cell, n_states in ((RNNCell(8, prefix="r_"), 1),
+                           (LSTMCell(8, prefix="l_"), 2),
+                           (GRUCell(8, prefix="g_"), 1)):
+        outputs, states = cell.unroll(3, input_prefix="x_")
+        assert len(outputs) == 3
+        assert len(states) == n_states
+        g = mx.sym.Group(outputs)
+        shapes = {"x_t%d_data" % t: (4, 5) for t in range(3)}
+        _, out_shapes, _ = g.infer_shape(__batch_size__=4, **shapes)
+        assert all(s == (4, 8) for s in out_shapes)
+
+
+def test_fused_matches_unfused_lstm():
+    """The fused (lax.scan) path and the unrolled graph must agree."""
+    T, N, I, H = 4, 2, 3, 5
+    fused = FusedRNNCell(H, num_layers=1, mode="lstm", prefix="lstm_",
+                         get_next_state=True)
+    f_out, f_states = fused.unroll(T, inputs=mx.sym.Variable("data"),
+                                   layout="NTC", merge_outputs=True)
+    ex_f, args_f = _run_sym(mx.sym.Group([f_out] + f_states),
+                            {"data": (N, T, I)})
+    outs_f = ex_f.forward(is_train=False)
+
+    unfused = fused.unfuse()
+    u_out, u_states = unfused.unroll(T, inputs=mx.sym.Variable("data"),
+                                     layout="NTC", merge_outputs=True)
+    ex_u = mx.sym.Group([u_out]).simple_bind(ctx=mx.cpu(), data=(N, T, I),
+                                             __batch_size__=N)
+    # fused packed vector -> per-gate entries -> per-cell fused i2h/h2h
+    cell_args = unfused.pack_weights(fused.unpack_weights(
+        {"lstm_parameters": mx.nd.array(args_f["lstm_parameters"])}))
+    for name, arr in ex_u.arg_dict.items():
+        if name == "data":
+            arr[:] = args_f["data"]
+        elif name in cell_args:
+            arr[:] = cell_args[name].asnumpy()
+        else:
+            arr[:] = 0.0
+    outs_u = ex_u.forward(is_train=False)
+    np.testing.assert_allclose(outs_u[0].asnumpy(), outs_f[0].asnumpy(),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pack_unpack_roundtrip():
+    for mode, bidir in (("lstm", False), ("gru", True), ("rnn_tanh", False)):
+        cell = FusedRNNCell(6, num_layers=2, mode=mode, bidirectional=bidir,
+                            prefix="f_")
+        from mxnet_tpu.ops.rnn_op import rnn_param_size
+        n = rnn_param_size(2, 4, 6, mode, bidir)
+        packed = mx.nd.array(
+            np.random.RandomState(0).uniform(-1, 1, (n,)).astype(np.float32))
+        unpacked = cell.unpack_weights({"f_parameters": packed})
+        assert "f_parameters" not in unpacked
+        repacked = cell.pack_weights(unpacked)
+        np.testing.assert_array_equal(repacked["f_parameters"].asnumpy(),
+                                      packed.asnumpy())
+
+
+def test_bidirectional_residual_stack():
+    stack = SequentialRNNCell()
+    stack.add(BidirectionalCell(LSTMCell(4, prefix="fl_"),
+                                LSTMCell(4, prefix="fr_"),
+                                output_prefix="bi_"))
+    outputs, _ = stack._cells[0].unroll(3, input_prefix="x_",
+                                        merge_outputs=True)
+    shapes = {"x_t%d_data" % t: (2, 5) for t in range(3)}
+    _, out_shapes, _ = outputs.infer_shape(__batch_size__=2, **shapes)
+    assert out_shapes == [(2, 3, 8)]    # fwd+bwd concat on channel
+
+    res = ResidualCell(RNNCell(5, prefix="rr_"))
+    outputs, _ = res.unroll(2, input_prefix="y_")
+    shapes = {"y_t%d_data" % t: (2, 5) for t in range(2)}
+    _, out_shapes, _ = mx.sym.Group(outputs).infer_shape(__batch_size__=2,
+                                                         **shapes)
+    assert all(s == (2, 5) for s in out_shapes)
+
+
+def test_encode_sentences_and_bucket_iter():
+    sents = [["a", "b", "c"], ["a", "b"], ["b", "c"], ["a", "b", "c", "d"],
+             ["a", "c"], ["b", "a"], ["c", "b", "a"]]
+    encoded, vocab = encode_sentences(sents, start_label=1)
+    assert all(isinstance(i, int) for s in encoded for i in s)
+    assert len(set(vocab.values())) == len(vocab)
+
+    it = BucketSentenceIter(encoded, batch_size=2, buckets=[2, 3],
+                            invalid_label=-1, seed=7)
+    assert it.default_bucket_key == 3
+    seen = 0
+    for batch in it:
+        seen += 1
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        assert d.shape == (2, batch.bucket_key)
+        # label is the next-token shift with invalid tail
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+        assert np.all(l[:, -1] == -1)
+    assert seen >= 2
+    it.reset()
+    assert sum(1 for _ in it) == seen
+
+
+def test_bucket_iter_time_major():
+    sents = [[1, 2], [3, 4], [5, 6], [7, 8]]
+    it = BucketSentenceIter(sents, batch_size=2, buckets=[2], layout="TN")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 2)
+    assert batch.provide_data[0].layout == "TN"
