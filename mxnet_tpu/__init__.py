@@ -36,6 +36,8 @@ from . import lr_scheduler
 from . import callback
 from . import model
 from . import io
+from . import rtc
+from . import contrib
 from . import recordio
 from . import kvstore
 from . import kvstore as kv

@@ -1,0 +1,15 @@
+"""``mx.contrib`` — experimental-op namespaces.
+
+Reference: ``python/mxnet/contrib/__init__.py`` re-exports ``ndarray`` /
+``symbol`` modules that surface every registry op carrying the
+``_contrib_`` prefix under its bare name (``mx.contrib.nd.MultiBoxPrior``
+↔ registry ``_contrib_MultiBoxPrior``). Resolution is lazy (PEP 562) so
+ops registered after import — e.g. via ``mx.rtc.PallasKernel.register``
+— appear automatically.
+"""
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+
+__all__ = ["ndarray", "nd", "symbol", "sym"]

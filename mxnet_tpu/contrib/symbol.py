@@ -1,0 +1,24 @@
+"""``mx.contrib.sym`` — symbolic wrappers for ``_contrib_*`` registry ops
+(reference: python/mxnet/contrib/symbol.py, populated by
+``_init_symbol_module(..., "_contrib_")``)."""
+from __future__ import annotations
+
+from ..ops import OP_REGISTRY
+
+
+def __getattr__(name):
+    op = OP_REGISTRY.get("_contrib_" + name)
+    if op is None:
+        raise AttributeError(
+            "module %r has no attribute %r (no registry op named "
+            "'_contrib_%s')" % (__name__, name, name))
+    from ..symbol import make_symbol_function
+
+    fn = make_symbol_function(op)
+    globals()[name] = fn
+    return fn
+
+
+def __dir__():
+    return sorted(set(globals()) | {
+        n[len("_contrib_"):] for n in OP_REGISTRY if n.startswith("_contrib_")})

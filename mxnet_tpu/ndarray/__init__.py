@@ -52,3 +52,17 @@ class _RandomNamespace:
 
 
 random = _RandomNamespace()
+
+# later-reference-style alias: mx.nd.contrib.MultiBoxPrior (canonical home is
+# mx.contrib.nd, reference python/mxnet/contrib/ndarray.py)
+from ..contrib import ndarray as contrib  # noqa: E402
+
+
+def __getattr__(name):
+    """Ops registered after import (rtc.PallasKernel.register, user custom
+    kernels) resolve lazily — PEP 562 module fallback."""
+    if name in OP_REGISTRY:
+        wrapper = _make_wrapper(OP_REGISTRY[name])
+        setattr(_mod, name, wrapper)
+        return wrapper
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

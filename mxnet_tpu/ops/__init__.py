@@ -14,6 +14,9 @@ from . import random_op  # noqa: F401
 from . import nn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import contrib  # noqa: F401
+from . import vision  # noqa: F401
+from . import detection  # noqa: F401
+from . import pallas  # noqa: F401
 from . import optimizer_op  # noqa: F401
 from . import rnn_op  # noqa: F401
 

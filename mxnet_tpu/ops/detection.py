@@ -1,0 +1,436 @@
+"""Detection operators: MultiBoxPrior/Target/Detection (SSD), Proposal
+(Faster R-CNN RPN), CTCLoss.
+
+Reference: ``src/operator/contrib/multibox_prior.cc`` (anchor enumeration),
+``multibox_target.cc`` (matching + encoding), ``multibox_detection.cc``
+(decode + NMS), ``proposal.cc``/``multi_proposal.cc`` (RPN),
+``contrib/ctc_loss.cc`` (warp-ctc).
+
+TPU design: everything is fixed-shape. Matching loops become IoU-matrix
+argmax/scatter; NMS is a sorted O(A²) suppression mask driven by
+``lax.fori_loop``; invalid slots are padded with -1 exactly like the
+reference's outputs. CTC's dynamic-programming recursion is a ``lax.scan``
+over time in log space, and its gradient is jax autodiff of that scan
+(the reference hand-codes warp-ctc's backward).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, get_op
+
+
+def _ftup(v, n=None):
+    if isinstance(v, (int, float)):
+        t = (float(v),)
+    else:
+        t = tuple(float(x) for x in v)
+    if n is not None and len(t) == 1:
+        t = t * n
+    return t
+
+
+def box_iou(a, b):
+    """Pairwise IoU of corner-format boxes: a (A, 4) x b (B, 4) -> (A, B)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_keep(boxes, scores, classes, thresh, force_suppress, topk):
+    """Greedy NMS over score-sorted boxes; returns (order, keep-in-order).
+
+    Scores <= -inf mark invalid slots. ``topk`` bounds how many sorted
+    boxes may act as suppressors (reference nms_topk)."""
+    A = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    bs = boxes[order]
+    cs = classes[order]
+    valid = scores[order] > -jnp.inf
+    iou = box_iou(bs, bs)
+    same = jnp.ones((A, A), bool) if force_suppress \
+        else (cs[:, None] == cs[None, :])
+    sup = (iou > thresh) & same
+    limit = A if (topk is None or topk < 0) else min(int(topk), A)
+    idx = jnp.arange(A)
+
+    def body(i, keep):
+        row = sup[i] & (idx > i) & keep[i] & valid[i]
+        return keep & ~row
+
+    keep = lax.fori_loop(0, limit, body, valid)
+    if topk is not None and topk >= 0:
+        # reference nms_topk also drops boxes ranked beyond top-k entirely
+        keep = keep & (idx < limit)
+    return order, keep
+
+
+# ----------------------------------------------------------------- priors
+
+
+@register("MultiBoxPrior", num_inputs=1,
+          aliases=("_contrib_MultiBoxPrior", "multibox_prior"))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generation (reference:
+    src/operator/contrib/multibox_prior.cc MultiBoxPriorForward): per cell,
+    one box per size at ratio[0], then one per extra ratio at sizes[0];
+    output (1, H*W*A, 4) normalized corners."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = _ftup(sizes)
+    ratios = _ftup(ratios)
+    steps = _ftup(steps, 2)
+    offsets = _ftup(offsets, 2)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+
+    # (w, h) half-extents in the reference's enumeration order
+    wh = [(s / 2.0, s / 2.0) for s in sizes]
+    wh += [(sizes[0] * np.sqrt(r) / 2.0, sizes[0] / np.sqrt(r) / 2.0)
+           for r in ratios[1:]]
+    wh = jnp.asarray(wh, data.dtype)                        # (A, 2)
+
+    cy = (jnp.arange(H, dtype=data.dtype) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=data.dtype) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"),
+                    axis=-1).reshape(H * W, 1, 2)           # (HW, 1, [y,x])
+    half = wh[None, :, ::-1]                                # (1, A, [h,w])
+    mins = cyx - half                                       # y-x order
+    maxs = cyx + half
+    boxes = jnp.concatenate(
+        [mins[..., 1:2], mins[..., 0:1], maxs[..., 1:2], maxs[..., 0:1]],
+        axis=-1).reshape(1, -1, 4)                          # x1 y1 x2 y2
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# ----------------------------------------------------------------- target
+
+
+def _encode_box(anchor, gt, variances):
+    aw = anchor[:, 2] - anchor[:, 0]
+    ah = anchor[:, 3] - anchor[:, 1]
+    ax = (anchor[:, 0] + anchor[:, 2]) * 0.5
+    ay = (anchor[:, 1] + anchor[:, 3]) * 0.5
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    vx, vy, vw, vh = variances
+    return jnp.stack([(gx - ax) / aw / vx, (gy - ay) / ah / vy,
+                      jnp.log(jnp.maximum(gw / aw, 1e-12)) / vw,
+                      jnp.log(jnp.maximum(gh / ah, 1e-12)) / vh], axis=1)
+
+
+@register("MultiBoxTarget", num_inputs=3,
+          aliases=("_contrib_MultiBoxTarget", "multibox_target"))
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference:
+    src/operator/contrib/multibox_target.cc MultiBoxTargetForward).
+
+    anchor (1, A, 4); label (N, O, 5) rows [cls, x1, y1, x2, y2], cls = -1
+    padding; cls_pred (N, C, A) (consulted only for negative mining).
+    Returns (box_target (N, A*4), box_mask (N, A*4), cls_target (N, A)).
+    Matching: each gt claims its best anchor (bipartite step), then anchors
+    with best-gt IoU >= threshold match that gt.
+    """
+    variances = _ftup(variances, 4)
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+
+    def one(lbl, cpred):
+        O = lbl.shape[0]
+        valid = lbl[:, 0] >= 0
+        iou = box_iou(anchors, lbl[:, 1:5])                 # (A, O)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # bipartite step (reference: each gt gets a distinct forced anchor):
+        # greedily claim the globally-best remaining (anchor, gt) pair and
+        # retire both, O rounds — two gts can't collide on one anchor
+        def bip(_, st):
+            f_matched, f_gt, m = st
+            flat = jnp.argmax(m)
+            a, o = flat // O, flat % O
+            good = m.ravel()[flat] > 1e-12
+            f_matched = jnp.where(good, f_matched.at[a].set(True), f_matched)
+            f_gt = jnp.where(good, f_gt.at[a].set(o), f_gt)
+            m = jnp.where(good,
+                          m.at[a, :].set(-1.0).at[:, o].set(-1.0), m)
+            return f_matched, f_gt, m
+
+        f_matched, f_gt, _ = lax.fori_loop(
+            0, O, bip, (jnp.zeros(A, bool), jnp.zeros(A, jnp.int32), iou))
+        matched = f_matched | (best_iou >= overlap_threshold)
+        best_gt = jnp.where(f_matched, f_gt, best_gt)
+
+        gt = lbl[best_gt]                                   # (A, 5)
+        box_t = _encode_box(anchors, gt[:, 1:5], variances)
+        box_t = jnp.where(matched[:, None], box_t, 0.0)
+        box_m = jnp.where(matched[:, None],
+                          jnp.ones((A, 4), box_t.dtype), 0.0)
+        cls_t = jnp.where(matched, gt[:, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negatives: unmatched anchors ranked by background
+            # confidence loss (low bg prob = hard), capped at
+            # ratio * num_pos (reference NegativeMining)
+            num_pos = matched.sum()
+            max_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                int(minimum_negative_samples))
+            neg_ok = (~matched) & (best_iou < negative_mining_thresh)
+            hardness = jnp.where(neg_ok, -cpred[0], -jnp.inf)
+            rank = jnp.argsort(jnp.argsort(-hardness))
+            selected = neg_ok & (rank < max_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(selected, 0.0, ignore_label))
+        return box_t.reshape(-1), box_m.reshape(-1), cls_t
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt, bm, ct
+
+
+get_op("MultiBoxTarget").num_outputs = 3
+get_op("MultiBoxTarget")._input_names = ["anchor", "label", "cls_pred"]
+
+
+# --------------------------------------------------------------- detection
+
+
+def _decode_boxes(anchors, loc, variances, clip):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    vx, vy, vw, vh = variances
+    ox = loc[:, 0] * vx * aw + ax
+    oy = loc[:, 1] * vy * ah + ay
+    ow = jnp.exp(loc[:, 2] * vw) * aw * 0.5
+    oh = jnp.exp(loc[:, 3] * vh) * ah * 0.5
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+    return jnp.clip(out, 0.0, 1.0) if clip else out
+
+
+@register("MultiBoxDetection", num_inputs=3,
+          aliases=("_contrib_MultiBoxDetection", "multibox_detection"))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD inference: decode + per-class NMS (reference:
+    src/operator/contrib/multibox_detection.cc). cls_prob (N, C, A) with
+    background class; returns (N, A, 6) rows [cls_id, score, x1, y1, x2,
+    y2], invalid rows marked cls_id = -1."""
+    variances = _ftup(variances, 4)
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+
+    def one(probs, loc):
+        # best non-background class per anchor; output ids compact away the
+        # background slot (reference: `id = j - 1` in multibox_detection.cc
+        # for background_id=0; ids below the background keep their index)
+        p = probs.at[background_id].set(-jnp.inf)
+        j = jnp.argmax(p, axis=0)
+        cls = jnp.where(j > background_id, j - 1, j).astype(loc.dtype)
+        score = jnp.max(p, axis=0)
+        keep0 = score > threshold
+        boxes = _decode_boxes(anchors, loc.reshape(A, 4), variances, clip)
+        scores = jnp.where(keep0, score, -jnp.inf)
+        order, keep = _nms_keep(boxes, scores, cls, nms_threshold,
+                                force_suppress, nms_topk)
+        out = jnp.concatenate(
+            [jnp.where(keep, cls[order], -1.0)[:, None],
+             jnp.where(keep, scores[order], -1.0)[:, None],
+             boxes[order]], axis=1)
+        return out
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+get_op("MultiBoxDetection")._input_names = ["cls_prob", "loc_pred", "anchor"]
+
+
+# ---------------------------------------------------------------- proposal
+
+
+def _rpn_base_anchors(base_size, scales, ratios):
+    """py-faster-rcnn style anchor enumeration (reference: proposal.cc
+    GenerateAnchors): keep area under ratio change, then scale."""
+    w = h = float(base_size)
+    x = y = (base_size - 1) / 2.0
+    out = []
+    size = w * h
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            w2, h2 = ws * s, hs * s
+            out.append([x - (w2 - 1) / 2, y - (h2 - 1) / 2,
+                        x + (w2 - 1) / 2, y + (h2 - 1) / 2])
+    return np.asarray(out, np.float32)
+
+
+@register("Proposal", num_inputs=3,
+          aliases=("_contrib_Proposal", "proposal",
+                   "_contrib_MultiProposal", "MultiProposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposal generation (reference:
+    src/operator/contrib/proposal.cc / multi_proposal.cc).
+
+    cls_prob (N, 2*A, H, W); bbox_pred (N, 4*A, H, W); im_info (N, 3)
+    [height, width, scale]. Returns rois (N*post, 5) [batch_idx, x1, y1,
+    x2, y2]; suppressed slots repeat the best box like the reference's
+    padding."""
+    scales = _ftup(scales)
+    ratios = _ftup(ratios)
+    N, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    base = jnp.asarray(_rpn_base_anchors(feature_stride, scales, ratios))
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack([sx[None, :].repeat(H, 0).ravel(),
+                       sy[:, None].repeat(W, 1).ravel()] * 2, axis=1)
+    anchors = (base[None, :, :] + shift[:, None, :]).reshape(-1, 4)
+    K = anchors.shape[0]          # H*W*A
+
+    def one(probs, deltas, info):
+        fg = probs[A:].reshape(A, H * W).T.reshape(-1)       # (K,)
+        d = deltas.reshape(A, 4, H * W).transpose(2, 0, 1).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        ax = anchors[:, 0] + aw * 0.5
+        ay = anchors[:, 1] + ah * 0.5
+        cx = d[:, 0] * aw + ax
+        cy = d[:, 1] * ah + ay
+        w = jnp.exp(d[:, 2]) * aw
+        h = jnp.exp(d[:, 3]) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                           cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)], axis=1)
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 1], 0, info[0] - 1),
+                           jnp.clip(boxes[:, 2], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=1)
+        min_sz = rpn_min_size * info[2]
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_sz) & \
+             ((boxes[:, 3] - boxes[:, 1] + 1) >= min_sz)
+        scores = jnp.where(ok, fg, -jnp.inf)
+        pre = min(int(rpn_pre_nms_top_n), K)
+        top_scores, top_idx = lax.top_k(scores, pre)
+        top_boxes = boxes[top_idx]
+        order, keep = _nms_keep(top_boxes, top_scores,
+                                jnp.zeros(pre), threshold, True, -1)
+        post = int(rpn_post_nms_top_n)
+        # unkept (and kept beyond post) entries scatter to index `post`,
+        # which mode="drop" discards — no slot collisions
+        kept_rank = jnp.where(keep, jnp.cumsum(keep) - 1, post)
+        out_boxes = jnp.zeros((post, 4), boxes.dtype)
+        out_boxes = out_boxes.at[kept_rank].set(top_boxes[order],
+                                                mode="drop")
+        out_scores = jnp.zeros((post,), scores.dtype)
+        out_scores = out_scores.at[kept_rank].set(top_scores[order],
+                                                  mode="drop")
+        n_kept = keep.sum()
+        # pad empty slots with the best proposal (reference pads with
+        # the first box)
+        pad_mask = jnp.arange(post) >= n_kept
+        out_boxes = jnp.where(pad_mask[:, None], out_boxes[0], out_boxes)
+        out_scores = jnp.where(pad_mask, out_scores[0], out_scores)
+        return out_boxes, out_scores
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    post = int(rpn_post_nms_top_n)
+    bidx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), post)[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+get_op("Proposal").num_outputs = \
+    lambda attrs: 2 if attrs.get("output_score") else 1
+get_op("Proposal")._input_names = ["cls_prob", "bbox_pred", "im_info"]
+
+
+# ---------------------------------------------------------------- CTC loss
+
+
+@register("CTCLoss", num_inputs=2,
+          aliases=("_contrib_CTCLoss", "ctc_loss"))
+def ctc_loss(data, label):
+    """Connectionist Temporal Classification loss (reference:
+    src/operator/contrib/ctc_loss.cc over warp-ctc).
+
+    data: (T, N, C) raw activations (softmax applied internally, like
+    warp-ctc); label: (N, L) with 0 = padding (labels use 1..C-1, blank is
+    class 0). Returns per-sequence negative log likelihood (N,). The
+    forward α-recursion is a ``lax.scan`` over time in log space; gradients
+    come from autodiff of that scan.
+    """
+    T, N, C = data.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+    logp = jax.nn.log_softmax(data, axis=-1)                 # (T, N, C)
+    lbl = label.astype(jnp.int32)                            # (N, L)
+    lengths = (lbl != 0).sum(axis=1)                         # (N,)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.zeros((N, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    # allowed skip: s -> s-2 when ext[s] != blank and != ext[s-2]
+    skip_ok = jnp.zeros((N, S), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != 0) & (ext[:, 2:] != ext[:, :-2]))
+    s_valid = jnp.arange(S)[None, :] < (2 * lengths[:, None] + 1)
+
+    neg_inf = jnp.array(-1e30, logp.dtype)
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lengths > 0,
+                  jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0],
+                  neg_inf))
+
+    def step(alpha, lp):
+        # lp: (N, C) log-probs at time t
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((N, 1), neg_inf),
+                                 alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((N, 2), neg_inf),
+                                 alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(skip_ok, prev2, neg_inf)
+        tot = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        emit = jnp.take_along_axis(lp, ext, axis=1)          # (N, S)
+        new = tot + emit
+        return jnp.where(s_valid, new, neg_inf), None
+
+    alpha_T, _ = lax.scan(step, alpha0, logp[1:])
+    last = 2 * lengths                                       # final blank
+    a_last = jnp.take_along_axis(alpha_T, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(
+        lengths > 0,
+        jnp.take_along_axis(alpha_T,
+                            jnp.maximum(last - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        neg_inf)
+    ll = jnp.logaddexp(a_last, a_prev)
+    return -ll
+
+
+get_op("CTCLoss")._input_names = ["data", "label"]

@@ -41,7 +41,7 @@ from .. import amp
 def _tup(x, n=None):
     if x is None:
         return None
-    t = (x,) if isinstance(x, (int, float)) else tuple(int(v) for v in x)
+    t = (int(x),) if isinstance(x, (int, float)) else tuple(int(v) for v in x)
     if n is not None and len(t) == 1:
         t = t * n
     return t

@@ -1,0 +1,3 @@
+"""Hand-written Pallas TPU kernels (the §2.22 RTC tier — see
+mxnet_tpu/rtc.py for the user-facing API)."""
+from .flash_attention import flash_attention  # noqa: F401

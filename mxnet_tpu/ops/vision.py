@@ -1,0 +1,292 @@
+"""Spatial / sampling operators: ROIPooling, PSROIPooling, BilinearSampler,
+GridGenerator, SpatialTransformer, Correlation, DeformableConvolution.
+
+Reference: ``src/operator/roi_pooling.cc``, ``bilinear_sampler.cc``,
+``grid_generator.cc``, ``spatial_transformer.cc``, ``correlation.cc``,
+``src/operator/contrib/{psroi_pooling,deformable_convolution}.cc``.
+
+TPU design: every op is a fixed-shape tensor program — region loops become
+masked reductions, sampling becomes vectorized 4-corner gathers, and the
+displacement/kernel enumerations are static Python loops over small
+constants that XLA unrolls and fuses. Gradients fall out of jax autodiff
+(the reference hand-writes each backward kernel).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, get_op
+from .nn import _tup
+from .. import amp
+
+
+# ----------------------------------------------------------------- ROI pool
+
+
+@register("ROIPooling", num_inputs=2, aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
+    """Max-pool each ROI onto a fixed (ph, pw) grid (reference:
+    src/operator/roi_pooling.cc ROIPoolForward).
+
+    data: (N, C, H, W); rois: (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coords. Region loops -> per-bin boolean masks + masked max, vmapped
+    over ROIs; empty bins yield 0 like the reference.
+    """
+    ph, pw = _tup(pooled_size, 2)
+    N, C, H, W = data.shape
+    iy = jnp.arange(H)
+    ix = jnp.arange(W)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        bin_h = rh.astype(jnp.float32) / ph
+        bin_w = rw.astype(jnp.float32) / pw
+        hs = jnp.clip(jnp.floor(jnp.arange(ph) * bin_h).astype(jnp.int32)
+                      + y1, 0, H)
+        he = jnp.clip(jnp.ceil((jnp.arange(ph) + 1) * bin_h).astype(jnp.int32)
+                      + y1, 0, H)
+        ws = jnp.clip(jnp.floor(jnp.arange(pw) * bin_w).astype(jnp.int32)
+                      + x1, 0, W)
+        we = jnp.clip(jnp.ceil((jnp.arange(pw) + 1) * bin_w).astype(jnp.int32)
+                      + x1, 0, W)
+        mh = (iy[None, :] >= hs[:, None]) & (iy[None, :] < he[:, None])
+        mw = (ix[None, :] >= ws[:, None]) & (ix[None, :] < we[:, None])
+        m = mh[:, None, :, None] & mw[None, :, None, :]       # (ph,pw,H,W)
+        img = jnp.take(data, b, axis=0)                       # (C,H,W)
+        masked = jnp.where(m[None], img[:, None, None],
+                           jnp.array(-jnp.inf, data.dtype))
+        out = masked.max(axis=(-1, -2))                       # (C,ph,pw)
+        empty = ~jnp.any(m, axis=(-1, -2))
+        return jnp.where(empty[None], jnp.zeros((), data.dtype), out)
+
+    return jax.vmap(one)(rois)
+
+
+@register("PSROIPooling", num_inputs=2,
+          aliases=("_contrib_PSROIPooling", "psroi_pooling"))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                  pooled_size=None, group_size=0):
+    """Position-sensitive ROI average pooling (reference:
+    src/operator/contrib/psroi_pooling.cc, R-FCN). Output channel c at bin
+    (i, j) averages input channel c*g*g + i*g + j inside the bin."""
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    od = int(output_dim)
+    N, C, H, W = data.shape
+    iy = jnp.arange(H)
+    ix = jnp.arange(W)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        # R-FCN rounds the roi to pixel centers at feature scale
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        hs = jnp.clip(jnp.floor(jnp.arange(p) * rh / p + y1)
+                      .astype(jnp.int32), 0, H)
+        he = jnp.clip(jnp.ceil((jnp.arange(p) + 1) * rh / p + y1)
+                      .astype(jnp.int32), 0, H)
+        ws = jnp.clip(jnp.floor(jnp.arange(p) * rw / p + x1)
+                      .astype(jnp.int32), 0, W)
+        we = jnp.clip(jnp.ceil((jnp.arange(p) + 1) * rw / p + x1)
+                      .astype(jnp.int32), 0, W)
+        mh = (iy[None, :] >= hs[:, None]) & (iy[None, :] < he[:, None])
+        mw = (ix[None, :] >= ws[:, None]) & (ix[None, :] < we[:, None])
+        m = (mh[:, None, :, None] & mw[None, :, None, :]).astype(data.dtype)
+        img = jnp.take(data, b, axis=0).reshape(od, g * g, H, W)
+        # bin (i,j) reads channel plane i*g+j of each output channel's block
+        plane_idx = (jnp.arange(p)[:, None] * g
+                     + jnp.arange(p)[None, :]).reshape(-1)
+        planes = jnp.take(img, plane_idx, axis=1)      # (od, p*p, H, W)
+        mk = m.reshape(p * p, H, W)
+        s = jnp.einsum("khw,ckhw->ck", mk, planes)     # bin k pools plane k
+        cnt = jnp.maximum(mk.sum((-1, -2)), 1.0)
+        return (s / cnt[None]).reshape(od, p, p)
+
+    return jax.vmap(one)(rois)
+
+
+# ------------------------------------------------------------- sampling ops
+
+
+def _bilinear_gather(data, gx, gy):
+    """Sample data (C, H, W) at fractional pixel coords gx/gy (...,) with
+    zero padding outside — the reference samplers' border behavior."""
+    C, H, W = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+    out = 0.0
+    for dy, dx, w in ((0, 0, (1 - wx) * (1 - wy)), (0, 1, wx * (1 - wy)),
+                      (1, 0, (1 - wx) * wy), (1, 1, wx * wy)):
+        xi = x0.astype(jnp.int32) + dx
+        yi = y0.astype(jnp.int32) + dy
+        valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        xi = jnp.clip(xi, 0, W - 1)
+        yi = jnp.clip(yi, 0, H - 1)
+        v = data[:, yi, xi]                    # (C, ...) advanced indexing
+        out = out + v * (w * valid)[None]
+    return out
+
+
+@register("BilinearSampler", num_inputs=2, aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid):
+    """Sample data at grid locations (reference:
+    src/operator/bilinear_sampler.cc). grid: (N, 2, Ho, Wo), channel 0 = x,
+    channel 1 = y, both normalized to [-1, 1]; outside is zero-padded."""
+    N, C, H, W = data.shape
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return jax.vmap(_bilinear_gather)(data, gx, gy)
+
+
+@register("GridGenerator", num_inputs=1)
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Generate a sampling grid (reference: src/operator/grid_generator.cc).
+
+    affine: data (N, 6) row-major 2x3 theta -> (N, 2, H, W) source coords.
+    warp: data (N, 2, H, W) pixel flow added to the identity grid.
+    """
+    if transform_type == "affine":
+        H, W = _tup(target_shape, 2)
+        ys, xs = jnp.meshgrid(jnp.linspace(-1.0, 1.0, H),
+                              jnp.linspace(-1.0, 1.0, W), indexing="ij")
+        tgt = jnp.stack([xs.ravel(), ys.ravel(),
+                         jnp.ones(H * W)])                  # (3, HW)
+        theta = data.reshape(-1, 2, 3)
+        src = jnp.einsum("nij,jk->nik", theta, tgt)         # (N, 2, HW)
+        return src.reshape(-1, 2, H, W)
+    elif transform_type == "warp":
+        N, _, H, W = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(H, dtype=data.dtype),
+                              jnp.arange(W, dtype=data.dtype), indexing="ij")
+        gx = (data[:, 0] + xs) * 2.0 / jnp.maximum(W - 1, 1) - 1.0
+        gy = (data[:, 1] + ys) * 2.0 / jnp.maximum(H - 1, 1) - 1.0
+        return jnp.stack([gx, gy], axis=1)
+    raise ValueError("transform_type must be 'affine' or 'warp'")
+
+
+@register("SpatialTransformer", num_inputs=2,
+          aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine", sampler_type="bilinear"):
+    """STN: affine grid from loc + bilinear sampling (reference:
+    src/operator/spatial_transformer.cc; Jaderberg et al. 2015)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("only affine/bilinear is supported (as in the "
+                         "reference)")
+    grid = grid_generator.fn(loc, transform_type="affine",
+                             target_shape=target_shape)
+    return bilinear_sampler.fn(data, grid)
+
+
+# ------------------------------------------------------------- correlation
+
+
+@register("Correlation", num_inputs=2)
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Patch cross-correlation between two feature maps (reference:
+    src/operator/correlation.cc; FlowNet). For each displacement on a
+    (2d+1)^2 grid, the channel-mean of the kernel-window product — the
+    displacement enumeration is a static loop XLA unrolls."""
+    N, C, H, W = data1.shape
+    k = int(kernel_size)
+    d = int(max_displacement)
+    s1, s2, pad = int(stride1), int(stride2), int(pad_size)
+    steps = d // s2
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    bk = k // 2
+    win = jnp.ones((1, 1, k, k), data1.dtype)
+
+    maps = []
+    for dy in range(-steps, steps + 1):
+        for dx in range(-steps, steps + 1):
+            shifted = jnp.roll(p2, (-dy * s2, -dx * s2), axis=(2, 3))
+            prod = p1 * shifted if is_multiply else jnp.abs(p1 - shifted)
+            summed = lax.conv_general_dilated(
+                prod.reshape(N * C, 1, Hp, Wp), win, (1, 1),
+                [(bk, bk), (bk, bk)]).reshape(N, C, Hp, Wp)
+            maps.append(summed.mean(axis=1))
+    out = jnp.stack(maps, axis=1)          # (N, D², Hp, Wp)
+    out = out[:, :, bk + d:Hp - bk - d:s1, bk + d:Wp - bk - d:s1]
+    return out / (k * k)
+
+
+# ----------------------------------------------------- deformable conv
+
+
+@register("DeformableConvolution", num_inputs=None,
+          aliases=("_contrib_DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=None, dilate=None, pad=None,
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           workspace=1024):
+    """Deformable convolution v1 (reference:
+    src/operator/contrib/deformable_convolution.cc, Dai et al. 2017).
+
+    offset: (N, 2*dg*kh*kw, Ho, Wo) — per kernel tap (dy, dx) pairs. Each
+    tap bilinearly samples the input at its offset position; the conv then
+    reduces over taps via einsum — im2col becomes gather + matmul (MXU).
+    """
+    kh, kw = _tup(kernel, 2)
+    sh, sw = _tup(stride, 2) or (1, 1)
+    dh, dw = _tup(dilate, 2) or (1, 1)
+    ph_, pw_ = _tup(pad, 2) or (0, 0)
+    N, C, H, W = data.shape
+    F = int(num_filter)
+    g = int(num_group)
+    dg = int(num_deformable_group)
+    Ho = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+    data, weight = amp.cast_compute(data, weight)
+
+    base_y = jnp.arange(Ho) * sh - ph_
+    base_x = jnp.arange(Wo) * sw - pw_
+    off = offset.reshape(N, dg, kh * kw, 2, Ho, Wo)
+    cpg = C // dg    # channels per deformable group
+
+    taps = []
+    for ki in range(kh):
+        for kj in range(kw):
+            t = ki * kw + kj
+            gy = base_y[:, None] + ki * dh + off[:, :, t, 0]    # (N,dg,Ho,Wo)
+            gx = base_x[None, :] + kj * dw + off[:, :, t, 1]
+
+            def sample(img, gy_, gx_):
+                # img (dg, cpg, H, W) ; gy_/gx_ (dg, Ho, Wo)
+                return jax.vmap(_bilinear_gather)(img, gx_, gy_)
+
+            smp = jax.vmap(sample)(data.reshape(N, dg, cpg, H, W),
+                                   gy.astype(data.dtype),
+                                   gx.astype(data.dtype))
+            taps.append(smp.reshape(N, C, Ho, Wo))
+    col = jnp.stack(taps, axis=2)           # (N, C, kh*kw, Ho, Wo)
+    col = col.reshape(N, g, C // g, kh * kw, Ho, Wo)
+    w = weight.reshape(g, F // g, C // g, kh * kw)
+    out = jnp.einsum("ngckhw,gfck->ngfhw", col, w,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, F, Ho, Wo).astype(jnp.result_type(data, weight))
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1).astype(out.dtype)
+    return out
+
+
+get_op("DeformableConvolution")._input_names = ["data", "offset", "weight",
+                                                "bias"]

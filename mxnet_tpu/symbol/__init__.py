@@ -66,3 +66,17 @@ def _attach_symbol_methods():
 
 _init_symbol_module()
 _attach_symbol_methods()
+
+# later-reference-style alias: mx.sym.contrib.MultiBoxPrior (canonical home is
+# mx.contrib.sym, reference python/mxnet/contrib/symbol.py)
+from ..contrib import symbol as contrib  # noqa: E402
+
+
+def __getattr__(name):
+    """Ops registered after import (rtc.PallasKernel.register, user custom
+    kernels) resolve lazily — PEP 562 module fallback."""
+    if name in OP_REGISTRY:
+        fn = make_symbol_function(OP_REGISTRY[name])
+        globals()[name] = fn
+        return fn
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

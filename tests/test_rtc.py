@@ -1,0 +1,174 @@
+"""Custom-kernel escape hatch: mx.rtc.PallasKernel + the flash-attention
+showcase kernel (reference surface: python/mxnet/rtc.py / mxrtc.h §2.22 —
+NVRTC there, Pallas here). Runs in Pallas interpreter mode on the CPU rig;
+numerics are identical to the compiled TPU path."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_pallas_kernel_elementwise():
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0 + y_ref[:]
+
+    kern = mx.rtc.PallasKernel(scale_add, ((8, 128), np.float32),
+                               interpret=True)
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 128).astype(np.float32)
+    y = rng.rand(8, 128).astype(np.float32)
+    out = kern(mx.nd.array(x), mx.nd.array(y))
+    np.testing.assert_allclose(out.asnumpy(), x * 2 + y, rtol=1e-6)
+
+
+def test_pallas_kernel_register_as_op():
+    def relu_k(x_ref, o_ref):
+        import jax.numpy as jnp
+        o_ref[:] = jnp.maximum(x_ref[:], 0.0)
+
+    kern = mx.rtc.PallasKernel(relu_k, ((4, 128), np.float32),
+                               interpret=True)
+    kern.register("my_pallas_relu")
+    x = np.random.RandomState(1).randn(4, 128).astype(np.float32)
+    out = mx.nd.my_pallas_relu(mx.nd.array(x))
+    np.testing.assert_allclose(out.asnumpy(), np.maximum(x, 0), rtol=1e-6)
+    # symbol path too
+    s = mx.sym.my_pallas_relu(mx.sym.Variable("data"))
+    ex = s.simple_bind(ctx=mx.cpu(), data=(4, 128))
+    ex.arg_dict["data"][:] = x
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), np.maximum(x, 0),
+                               rtol=1e-6)
+
+
+def test_cuda_module_points_to_pallas():
+    with pytest.raises(NotImplementedError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k(){}")
+
+
+def _ref_attention(q, k, v, causal=False):
+    B, H, S, D = q.shape
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_flash_attention_matches_reference():
+    import jax
+    rng = np.random.RandomState(2)
+    B, H, S, D = 2, 2, 256, 32
+    q = rng.randn(B, H, S, D).astype(np.float32)
+    k = rng.randn(B, H, S, D).astype(np.float32)
+    v = rng.randn(B, H, S, D).astype(np.float32)
+    from mxnet_tpu.ops.pallas import flash_attention
+    # pin to CPU: on this rig raw numpy lands on the axon TPU, whose f32
+    # matmuls round differently than the f64 oracle demands
+    cpu = jax.local_devices(backend="cpu")[0]
+    qj, kj, vj = (jax.device_put(a, cpu) for a in (q, k, v))
+    out = np.asarray(flash_attention(qj, kj, vj, block_q=128, block_k=128,
+                                     interpret=True))
+    np.testing.assert_allclose(out, _ref_attention(q, k, v),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_causal_and_op():
+    rng = np.random.RandomState(3)
+    B, H, S, D = 1, 2, 128, 16
+    q = rng.randn(B, H, S, D).astype(np.float32)
+    k = rng.randn(B, H, S, D).astype(np.float32)
+    v = rng.randn(B, H, S, D).astype(np.float32)
+    out = mx.nd.FlashAttention(mx.nd.array(q), mx.nd.array(k),
+                               mx.nd.array(v), causal=True,
+                               block_q=64, block_k=64).asnumpy()
+    np.testing.assert_allclose(out, _ref_attention(q, k, v, causal=True),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_grad_matches_xla():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import flash_attention
+    rng = np.random.RandomState(4)
+    B, H, S, D = 1, 1, 128, 16
+    cpu = jax.local_devices(backend="cpu")[0]
+    q = jax.device_put(rng.randn(B, H, S, D).astype(np.float32), cpu)
+    k = jax.device_put(rng.randn(B, H, S, D).astype(np.float32), cpu)
+    v = jax.device_put(rng.randn(B, H, S, D).astype(np.float32), cpu)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, interpret=True).sum()
+
+    def f_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        p = jax.nn.softmax(s, -1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_causal_grad_with_padded_q():
+    # S not a multiple of block_q: the recompute backward must use the same
+    # top-aligned causal mask as the kernel (regression: a bottom-aligned
+    # tril offset corrupted every real row's gradient)
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import flash_attention
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 1, 96, 16
+    cpu = jax.local_devices(backend="cpu")[0]
+    q = jax.device_put(rng.randn(B, H, S, D).astype(np.float32), cpu)
+    k = jax.device_put(rng.randn(B, H, S, D).astype(np.float32), cpu)
+    v = jax.device_put(rng.randn(B, H, S, D).astype(np.float32), cpu)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64, block_k=32,
+                               interpret=True).sum()
+
+    def f_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+        p = jax.nn.softmax(s, -1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pallas_kernel_multi_output_symbol_visible():
+    def split_k(x_ref, a_ref, b_ref):
+        a_ref[:] = x_ref[:] * 2.0
+        b_ref[:] = x_ref[:] + 1.0
+
+    kern = mx.rtc.PallasKernel(
+        split_k, [((4, 128), np.float32), ((4, 128), np.float32)],
+        interpret=True)
+    kern.register("my_pallas_split")
+    x = np.random.RandomState(8).rand(4, 128).astype(np.float32)
+    a, b = mx.nd.my_pallas_split(mx.nd.array(x))
+    np.testing.assert_allclose(a.asnumpy(), x * 2, rtol=1e-6)
+    np.testing.assert_allclose(b.asnumpy(), x + 1, rtol=1e-6)
+    s = mx.sym.my_pallas_split(mx.sym.Variable("data"))
+    assert len(s.list_outputs()) == 2
+    ex = s.simple_bind(ctx=mx.cpu(), data=(4, 128))
+    ex.arg_dict["data"][:] = x
+    outs = ex.forward()
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[1].asnumpy(), x + 1, rtol=1e-6)
+
+
+def test_flash_attention_rejects_unaligned_keys():
+    q = np.zeros((1, 1, 64, 16), np.float32)
+    k = np.zeros((1, 1, 100, 16), np.float32)
+    with pytest.raises(ValueError, match="multiple of block_k"):
+        from mxnet_tpu.ops.pallas import flash_attention
+        flash_attention(q, k, k, block_k=64, interpret=True)

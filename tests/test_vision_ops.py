@@ -1,0 +1,363 @@
+"""Spatial + detection op family vs numpy oracles (reference tests:
+tests/python/unittest/test_operator.py test_roipooling/test_bilinear_sampler
+etc., tests/python/unittest/test_contrib_operator.py multibox tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_roi_pooling_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [1, 2, 2, 6, 6],
+                     [0, 4, 4, 4, 4]], np.float32)   # single-pixel roi
+    out = mx.nd.ROIPooling(mx.nd.array(x), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out.shape == (3, 3, 2, 2)
+    # full-image roi, 2x2 pooling = max over quadrants
+    expect = x[0].reshape(3, 2, 4, 2, 4).max(axis=(2, 4))
+    np.testing.assert_allclose(out[0], expect, rtol=1e-6)
+    # single-pixel roi: every bin containing the pixel reports it
+    np.testing.assert_allclose(out[2, :, 1, 1], x[0, :, 4, 4], rtol=1e-6)
+
+
+def test_roi_pooling_grad_flows():
+    x = mx.nd.array(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
+    x.attach_grad()
+    rois = mx.nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    with mx.autograd.record():
+        y = mx.nd.ROIPooling(x, rois, pooled_size=(1, 1), spatial_scale=1.0)
+        s = mx.nd.sum(y)
+    s.backward()
+    g = x.grad.asnumpy()
+    assert g.sum() == 2.0           # one max location per channel
+    assert g[0, 0, 3, 3] == 1.0 and g[0, 1, 3, 3] == 1.0
+
+
+def test_bilinear_sampler_identity_and_shift():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 2, 5, 5).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)
+    out = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-6)
+    # everything sampled far outside -> zeros
+    far = np.full_like(grid, 5.0)
+    out = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(far)).asnumpy()
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 1, 6, 6).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(theta),
+                                   target_shape=(6, 6)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow_is_identity():
+    flow = np.zeros((1, 2, 4, 4), np.float32)
+    g = mx.nd.GridGenerator(mx.nd.array(flow),
+                            transform_type="warp").asnumpy()
+    assert g.min() >= -1.0 - 1e-6 and g.max() <= 1.0 + 1e-6
+    x = np.random.RandomState(3).rand(1, 3, 4, 4).astype(np.float32)
+    out = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(g)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_reference_enumeration():
+    data = mx.nd.zeros((1, 3, 2, 2))
+    out = mx.nd.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0),
+                              steps=(-1.0, -1.0)).asnumpy()
+    # A = sizes + ratios - 1 = 3 anchors per cell
+    assert out.shape == (1, 2 * 2 * 3, 4)
+    # first cell center (0.25, 0.25); first anchor: size .5 ratio 1
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    np.testing.assert_allclose(out[0, 1], [0.125, 0.125, 0.375, 0.375],
+                               atol=1e-6)
+    # ratio-2 anchor of size .5? no: extra ratios use sizes[0]
+    w = 0.5 * np.sqrt(2.0) / 2
+    h = 0.5 / np.sqrt(2.0) / 2
+    np.testing.assert_allclose(out[0, 2],
+                               [0.25 - w, 0.25 - h, 0.25 + w, 0.25 + h],
+                               atol=1e-6)
+
+
+def test_multibox_target_matching_and_encoding():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], np.float32)
+    # one gt overlapping anchor 0 well, class 2
+    label = np.array([[[2, 0.05, 0.05, 0.45, 0.45],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    bt, bm, ct = mx.nd.MultiBoxTarget(mx.nd.array(anchors),
+                                      mx.nd.array(label),
+                                      mx.nd.array(cls_pred))
+    ct = ct.asnumpy()
+    bm = bm.asnumpy().reshape(1, 3, 4)
+    bt = bt.asnumpy().reshape(1, 3, 4)
+    assert ct[0, 0] == 3.0          # class 2 -> target 3 (bg is 0)
+    assert ct[0, 1] == 0.0 and ct[0, 2] == 0.0
+    assert bm[0, 0].all() and not bm[0, 1].any()
+    # encoding: gt center == anchor center shifted by -0.0 -> dx = 0
+    aw = 0.5
+    gx, ax = 0.25, 0.25
+    np.testing.assert_allclose(bt[0, 0, 0], (gx - ax) / aw / 0.1, atol=1e-5)
+    np.testing.assert_allclose(bt[0, 0, 2],
+                               np.log(0.4 / 0.5) / 0.2, atol=1e-5)
+
+
+def test_multibox_target_two_gts_share_best_anchor():
+    # both gts' IoU-argmax is anchor 0; greedy bipartite must give the
+    # loser a distinct forced anchor instead of dropping it
+    anchors = np.array([[[0.0, 0.0, 1.0, 1.0],
+                         [0.0, 0.0, 0.4, 0.4],
+                         [2.0, 2.0, 3.0, 3.0]]], np.float32)
+    label = np.array([[[1, 0.0, 0.0, 0.9, 1.0],
+                       [2, 0.0, 0.0, 1.0, 0.9]]], np.float32)
+    cls_pred = np.zeros((1, 4, 3), np.float32)
+    _, _, ct = mx.nd.MultiBoxTarget(mx.nd.array(anchors),
+                                    mx.nd.array(label),
+                                    mx.nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    assert sorted(c for c in ct if c > 0) == [2.0, 3.0]
+
+
+def test_multibox_detection_nonzero_background_id():
+    # 3 classes with background at id 2: real classes keep ids 0 and 1
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    cls_prob = np.array([[[0.9, 0.1],
+                          [0.05, 0.8],
+                          [0.05, 0.1]]], np.float32)
+    loc = np.zeros((1, 8), np.float32)
+    out = mx.nd.MultiBoxDetection(mx.nd.array(cls_prob), mx.nd.array(loc),
+                                  mx.nd.array(anchors), background_id=2,
+                                  nms_threshold=0.5).asnumpy()
+    kept = out[0][out[0, :, 0] >= 0]
+    assert sorted(kept[:, 0].tolist()) == [0.0, 1.0]
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.11, 0.11, 0.31, 0.31],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # class 1 strong on anchors 0,1 (overlapping); class 2 on anchor 2
+    cls_prob = np.array([[[0.1, 0.2, 0.1],
+                          [0.8, 0.7, 0.05],
+                          [0.1, 0.1, 0.85]]], np.float32)
+    loc = np.zeros((1, 12), np.float32)
+    out = mx.nd.MultiBoxDetection(mx.nd.array(cls_prob), mx.nd.array(loc),
+                                  mx.nd.array(anchors),
+                                  nms_threshold=0.5).asnumpy()
+    assert out.shape == (1, 3, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    # anchor 1 suppressed by anchor 0 (same class, IoU > .5)
+    assert len(kept) == 2
+    classes = sorted(kept[:, 0].tolist())
+    assert classes == [0.0, 1.0]    # class ids shift down by 1 (bg removed)
+    cls0 = kept[kept[:, 0] == 0.0][0]
+    assert abs(cls0[1] - 0.8) < 1e-5      # anchor 0 won over anchor 1
+    np.testing.assert_allclose(cls0[2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_multibox_detection_nms_topk_drops_tail():
+    # nms_topk caps the number of surviving detections, not just the
+    # suppressor set (reference multibox_detection.cc)
+    anchors = np.array([[[0.0, 0.0, 0.2, 0.2],
+                         [0.4, 0.4, 0.6, 0.6],
+                         [0.8, 0.8, 1.0, 1.0]]], np.float32)
+    cls_prob = np.array([[[0.1, 0.2, 0.3],
+                          [0.9, 0.8, 0.7]]], np.float32)
+    loc = np.zeros((1, 12), np.float32)
+    out = mx.nd.MultiBoxDetection(mx.nd.array(cls_prob), mx.nd.array(loc),
+                                  mx.nd.array(anchors), nms_topk=1,
+                                  nms_threshold=0.5).asnumpy()
+    kept = out[0][out[0, :, 0] >= 0]
+    assert len(kept) == 1
+    assert abs(kept[0, 1] - 0.9) < 1e-5
+
+
+def test_proposal_shapes_and_clip():
+    rng = np.random.RandomState(4)
+    N, A, H, W = 1, 3, 4, 4
+    cls = rng.rand(N, 2 * A, H, W).astype(np.float32)
+    bbox = (rng.rand(N, 4 * A, H, W).astype(np.float32) - 0.5) * 0.2
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = mx.nd.Proposal(mx.nd.array(cls), mx.nd.array(bbox),
+                          mx.nd.array(im_info), feature_stride=16,
+                          scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+                          rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8,
+                          rpn_min_size=4).asnumpy()
+    assert rois.shape == (8, 5)
+    assert np.all(rois[:, 0] == 0)
+    assert rois[:, 1:].min() >= 0 and rois[:, 1:].max() <= 63
+
+
+def test_proposal_more_survivors_than_post_nms():
+    # NMS keeps more boxes than rpn_post_nms_top_n: every output slot must
+    # hold a real proposal (regression: unkept entries once scatter-wrote
+    # 0.0 into the last slot)
+    rng = np.random.RandomState(9)
+    N, A, H, W = 1, 3, 6, 6
+    cls = rng.rand(N, 2 * A, H, W).astype(np.float32) + 0.5
+    bbox = np.zeros((N, 4 * A, H, W), np.float32)
+    im_info = np.array([[96, 96, 1.0]], np.float32)
+    rois = mx.nd.Proposal(mx.nd.array(cls), mx.nd.array(bbox),
+                          mx.nd.array(im_info), feature_stride=16,
+                          scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+                          rpn_pre_nms_top_n=100, rpn_post_nms_top_n=4,
+                          threshold=0.95, rpn_min_size=4).asnumpy()
+    assert rois.shape == (4, 5)
+    w = rois[:, 3] - rois[:, 1]
+    h = rois[:, 4] - rois[:, 2]
+    assert (w > 0).all() and (h > 0).all()
+
+
+def _np_ctc_loss(logits, labels):
+    """Brute-force CTC by enumerating alignments (tiny T only)."""
+    from itertools import product
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in product(range(C), repeat=T):
+        # collapse repeats then drop blanks (0)
+        seq = []
+        prev = None
+        for s in path:
+            if s != prev:
+                seq.append(s)
+            prev = s
+        seq = [s for s in seq if s != 0]
+        if seq == list(labels):
+            total += np.prod([p[t, path[t]] for t in range(T)])
+    return -np.log(total)
+
+
+def test_ctc_loss_matches_bruteforce():
+    rng = np.random.RandomState(5)
+    T, N, C = 4, 2, 3
+    data = rng.randn(T, N, C).astype(np.float32)
+    label = np.array([[1, 2], [2, 0]], np.float32)   # 0 = padding
+    loss = mx.nd.CTCLoss(mx.nd.array(data), mx.nd.array(label)).asnumpy()
+    np.testing.assert_allclose(loss[0], _np_ctc_loss(data[:, 0], [1, 2]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(loss[1], _np_ctc_loss(data[:, 1], [2]),
+                               rtol=1e-4)
+
+
+def test_ctc_loss_grad_flows():
+    rng = np.random.RandomState(6)
+    x = mx.nd.array(rng.randn(5, 1, 4).astype(np.float32))
+    x.attach_grad()
+    lbl = mx.nd.array(np.array([[1, 3]], np.float32))
+    with mx.autograd.record():
+        loss = mx.nd.CTCLoss(x, lbl)
+        s = mx.nd.sum(loss)
+    s.backward()
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_correlation_self_zero_displacement():
+    rng = np.random.RandomState(7)
+    x = rng.rand(1, 4, 6, 6).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(x), mx.nd.array(x), kernel_size=1,
+                            max_displacement=1, stride1=1, stride2=1,
+                            pad_size=1).asnumpy()
+    assert out.shape[1] == 9
+    # center displacement channel (index 4) is mean of x*x over channels
+    center = out[0, 4]
+    expect = (x[0] ** 2).mean(axis=0)
+    np.testing.assert_allclose(center, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(8)
+    x = rng.rand(1, 3, 7, 7).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 5, 5), np.float32)
+    out_d = mx.nd.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), num_filter=4, no_bias=True).asnumpy()
+    out_c = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                              kernel=(3, 3), num_filter=4,
+                              no_bias=True).asnumpy()
+    np.testing.assert_allclose(out_d, out_c, rtol=1e-4, atol=1e-5)
+
+
+def test_psroi_pooling_uniform_plane():
+    # each channel plane constant = its own index; output bin (i,j) of
+    # channel c must read plane c*g*g + i*g + j
+    od, g = 2, 2
+    x = np.zeros((1, od * g * g, 6, 6), np.float32)
+    for c in range(od * g * g):
+        x[0, c] = c
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = mx.nd.PSROIPooling(mx.nd.array(x), mx.nd.array(rois),
+                             spatial_scale=1.0, output_dim=od,
+                             pooled_size=g, group_size=g).asnumpy()
+    assert out.shape == (1, od, g, g)
+    for c in range(od):
+        for i in range(g):
+            for j in range(g):
+                assert out[0, c, i, j] == c * g * g + i * g + j
+
+
+def test_ssd_head_trains_one_step():
+    """A minimal SSD head (the §2.15 capability gate): conv features ->
+    cls/loc heads -> MultiBoxTarget -> losses; one fused train step."""
+    num_cls, A = 3, 4       # 2 sizes + 3 ratios - 1 = 4 anchors/cell
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    feat = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                              num_filter=8, name="feat")
+    feat = mx.sym.Activation(feat, act_type="relu")
+    cls_pred = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=(num_cls + 1) * A, name="cls")
+    loc_pred = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=4 * A, name="loc")
+    anchors = mx.sym.MultiBoxPrior(feat, sizes=(0.3, 0.6),
+                                   ratios=(1.0, 0.5, 2.0))
+    # (N, C+1, A*cells) / (N, A*cells*4)
+    cls_pred = mx.sym.reshape(mx.sym.transpose(cls_pred, axes=(0, 2, 3, 1)),
+                              shape=(0, -1, num_cls + 1))
+    cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 1))
+    loc_pred = mx.sym.reshape(mx.sym.transpose(loc_pred, axes=(0, 2, 3, 1)),
+                              shape=(0, -1))
+    box_t, box_m, cls_t = mx.sym.MultiBoxTarget(anchors, label, cls_pred,
+                                                name="target")
+    cls_loss = mx.sym.SoftmaxOutput(cls_pred, cls_t, multi_output=True,
+                                    use_ignore=True, ignore_label=-1,
+                                    normalization="valid", name="cls_prob")
+    loc_diff = (loc_pred - box_t) * box_m
+    loc_loss = mx.sym.MakeLoss(mx.sym.smooth_l1(loc_diff, scalar=1.0),
+                               grad_scale=1.0, name="loc_loss")
+    sym = mx.sym.Group([cls_loss, loc_loss])
+
+    N, H = 2, 8
+    mod = mx.mod.Module(sym, context=mx.cpu(), data_names=("data",),
+                        label_names=("label",))
+    mod.bind(data_shapes=[("data", (N, 3, H, H))],
+             label_shapes=[("label", (N, 2, 5))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    rng = np.random.RandomState(0)
+    x = rng.rand(N, 3, H, H).astype(np.float32)
+    y = np.array([[[1, 0.1, 0.1, 0.5, 0.5], [-1, 0, 0, 0, 0]],
+                  [[2, 0.4, 0.4, 0.9, 0.9], [0, 0.0, 0.0, 0.3, 0.3]]],
+                 np.float32)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    before = mod.get_params()[0]["cls_weight"].asnumpy().copy()
+    mod._fit_step(batch)
+    after = mod.get_params()[0]["cls_weight"].asnumpy()
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after)
