@@ -71,9 +71,12 @@ class NDArray:
         if isinstance(data, NDArray):
             data = data._data
         if not isinstance(data, jax.Array):
+            # numpy straight to the target device — routing through
+            # jnp.asarray would first land on the *default* device (the
+            # real chip when one is attached) and pay a second transfer
             data = np.asarray(data, dtype=dtype)
             dev = (ctx or current_context()).jax_device
-            data = jax.device_put(jnp.asarray(data), dev)
+            data = jax.device_put(data, dev)
         elif dtype is not None and jnp.dtype(dtype) != data.dtype:
             data = data.astype(jnp.dtype(dtype))
         if ctx is not None and isinstance(data, jax.Array):

@@ -26,8 +26,10 @@ def stochastic_activation_pruning(act, prob, frac=1.0, _rng=None):
     (reference lines 139-178) — here that falls out of vjp because ``mask``
     is built from ``stop_gradient`` samples.
 
-    TPU lowering: one ``jax.random.categorical`` batch draw + a scatter; the
-    reference's nested OpenMP/CUDA sampling loop becomes two fused HLOs.
+    TPU lowering: a batched inverse-CDF draw (cumsum + uniform +
+    searchsorted — compiles far faster than ``jax.random.categorical``'s
+    batched-logits path) + a scatter; the reference's nested OpenMP/CUDA
+    sampling loop becomes a handful of fused HLOs.
     """
     shape = act.shape
     rows = shape[0] if act.ndim > 1 else 1
@@ -35,9 +37,13 @@ def stochastic_activation_pruning(act, prob, frac=1.0, _rng=None):
     p2 = prob.reshape(rows, -1)
     cols = a2.shape[1]
     k = max(int(frac * cols), 1)
-    logits = jnp.log(jnp.maximum(jax.lax.stop_gradient(p2), 1e-37))
-    idx = jax.random.categorical(_rng, logits[:, None, :], axis=-1,
-                                 shape=(rows, k))
+    cdf = jnp.cumsum(jax.lax.stop_gradient(p2), axis=1)
+    u = jax.random.uniform(_rng, (rows, k), dtype=cdf.dtype) * cdf[:, -1:]
+    # side="right" skips zero-probability plateaus (u==0 or u exactly at a
+    # plateau edge must not select a p=0 category — its importance weight
+    # 1/(1-(1-0)^k) would be inf); clip guards the u→total rounding edge
+    idx = jax.vmap(lambda c, v: jnp.searchsorted(c, v, side="right"))(cdf, u)
+    idx = jnp.minimum(idx, cols - 1)
     weights = 1.0 / (1.0 - jnp.power(1.0 - jax.lax.stop_gradient(p2), k))
     mask = jnp.zeros_like(a2)
     rowix = jnp.arange(rows)[:, None]

@@ -18,3 +18,33 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The accelerator plugin on this machine rewrites JAX_PLATFORMS at interpreter
+# startup, so the env var alone does NOT keep jax off the real chip: without
+# the config override the *default* device stays the TPU and every
+# host->device transfer in the suite crosses the tunnel (~100ms each, plus
+# remote compiles — a 20x suite slowdown). Force the config directly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# persistent compile cache: jit programs survive across pytest runs
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import numpy as _np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    """Deterministic RNG per test regardless of execution order (the
+    reference seeds per-module; a shared global key made
+    test_module_fit_converges order-dependent)."""
+    _np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield
