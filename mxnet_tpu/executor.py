@@ -18,9 +18,11 @@ executor:
   after each step (the reference mutates them in-place during Forward).
 
 Model parallelism (`group2ctx`, reference graph_executor.cc:279-393
-AssignContext + PlaceDevice + _CrossDeviceCopy): expressed as per-argument
-``SingleDeviceSharding`` in ``jit(in_shardings=...)`` — XLA inserts the
-cross-device transfers the reference inserted as copy nodes.
+AssignContext + PlaceDevice + _CrossDeviceCopy): bound arrays are placed on
+their group's device and the graph executes op-by-op with explicit boundary
+transfers — the reference's one-engine-op-per-node schedule with copy
+nodes. One XLA program cannot span explicit single-device placements, so
+this mode is NOT wrapped in an outer jit (see graph_function).
 """
 from __future__ import annotations
 
@@ -50,7 +52,7 @@ def _accepts_is_train(op) -> bool:
     return cached
 
 
-def graph_function(symbol):
+def graph_function(symbol, node_device=None):
     """Compile a Symbol into a pure function
     ``fn(args_dict, aux_dict, rng_key, is_train) -> (outputs, new_aux_dict)``.
 
@@ -58,6 +60,14 @@ def graph_function(symbol):
     (graph_executor.cc:1013-1231): instead of one engine op per node, the
     topo-ordered node list becomes one traced JAX program for XLA to fuse
     and schedule.
+
+    ``node_device`` (optional) maps a node to a jax device for model
+    parallelism (group2ctx): each op then runs on its group's device with
+    explicit boundary transfers — the PlaceDevice + CopyNode pass of the
+    reference (graph_executor.cc:279-393). One XLA program cannot span
+    explicit single-device placements, so this mode executes op-by-op
+    (exactly the reference's one-engine-op-per-node schedule) and must not
+    be wrapped in an outer jit.
     """
     from .symbol.symbol import _topo_order
 
@@ -84,6 +94,12 @@ def graph_function(symbol):
                 attrs["_is_train"] = is_train
             if node.op.needs_rng:
                 attrs["_rng"] = jax.random.fold_in(key, idx)
+            if node_device is not None:
+                dev = node_device(node)
+                if dev is not None:
+                    # boundary transfer: inputs produced on another group's
+                    # device hop here (the reference's copy node)
+                    ins = [jax.device_put(x, dev) for x in ins]
             outs = node.op.fn(*ins, **attrs)
             if not isinstance(outs, tuple):
                 outs = (outs,)
@@ -151,7 +167,7 @@ class Executor:
 
         self._group2ctx = group2ctx
         self._shared_exec = shared_exec
-        self._fn = graph_function(symbol)
+        self._fn = graph_function(symbol, self._node_device_fn())
         self._base_key = _random.next_key()
         self._step = 0
         self._outputs: Optional[List[_nd.NDArray]] = None
@@ -159,11 +175,26 @@ class Executor:
         self._monitor_callback = None
 
         in_shardings = self._arg_shardings()
-        jit_kwargs = {"static_argnums": (3,)}
         if in_shardings is not None:
-            jit_kwargs["in_shardings"] = (in_shardings[0], in_shardings[1],
-                                          None)
-        self._jit_fwd = jax.jit(self._fn, **jit_kwargs)
+            # the PlaceDevice step (reference graph_executor.cc:279-393):
+            # move the bound arrays onto their group's device; the graph
+            # then executes op-by-op with boundary transfers (one XLA
+            # program cannot span explicit single-device placements)
+            arg_sh, aux_sh = in_shardings
+            for name, sh in arg_sh.items():
+                nd_arr = self.arg_dict[name]
+                if nd_arr.data.sharding != sh:
+                    nd_arr._data = jax.device_put(nd_arr.data, sh)
+                gbuf = self.grad_dict.get(name)
+                if gbuf is not None and gbuf.data.sharding != sh:
+                    gbuf._data = jax.device_put(gbuf.data, sh)
+            for name, sh in aux_sh.items():
+                nd_arr = self.aux_dict[name]
+                if nd_arr.data.sharding != sh:
+                    nd_arr._data = jax.device_put(nd_arr.data, sh)
+            self._jit_fwd = self._fn          # staged eager execution
+        else:
+            self._jit_fwd = jax.jit(self._fn, static_argnums=(3,))
 
         def fwd_bwd(arg_vals, aux_vals, key, head_grads):
             diff = {n: arg_vals[n] for n in self._wrt}
@@ -180,7 +211,25 @@ class Executor:
                                for k, v in new_aux.items()}))[0]
             return outs, new_aux, grads
 
-        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+        # group2ctx mode: jax.vjp over the staged fn runs forward op-by-op
+        # on the placed devices and replays transposed transfers backward
+        self._jit_fwd_bwd = fwd_bwd if in_shardings is not None \
+            else jax.jit(fwd_bwd)
+
+    # ------------------------------------------------------------ placement
+    def _node_device_fn(self):
+        """Node -> jax device from its ctx_group (None without group2ctx)."""
+        if not self._group2ctx:
+            return None
+        group2ctx = self._group2ctx
+        default = self._ctx
+
+        def node_device(node):
+            g = node.str_attrs.get("ctx_group")
+            ctx = group2ctx.get(g, default) if g else default
+            return ctx.jax_device
+
+        return node_device
 
     # ------------------------------------------------------------ shardings
     def _arg_shardings(self):

@@ -109,13 +109,27 @@ class Parameter(object):
         initializer = init if init is not None else \
             (self.init if self.init is not None else default_init)
         initializer(InitDesc(self.name, {"__init__": ""}), data)
-        self._data = data
+        self._data = self._place(data, ctx)
         if self._grad_req != "null":
             self._init_grad()
 
+    def _place(self, data, ctx):
+        """Multi-device: ONE array replicated over a data-parallel mesh
+        (the TPU form of the reference's per-ctx copies, parameter.py
+        _init_impl); batch-sharded inputs from split_and_load then train
+        data-parallel via GSPMD with the grad psum inserted by XLA."""
+        if ctx is not None and len(ctx) > 1:
+            from ..parallel.mesh import data_parallel_mesh, replicate
+            self._ctx_list = list(ctx)
+            return nd.NDArray(replicate(data_parallel_mesh(ctx), data.data))
+        self._ctx_list = None
+        return data
+
     def _init_grad(self):
-        self._grad = nd.zeros(self.shape, dtype=self.dtype,
-                              ctx=self._data.context)
+        import jax.numpy as jnp
+        # zeros_like keeps the data's sharding (replicated on a mesh when
+        # initialized with several contexts)
+        self._grad = nd.NDArray(jnp.zeros_like(self._data.data))
         from .. import autograd
         autograd.mark_variables([self._data], [self._grad],
                                 grad_reqs=self._grad_req)
@@ -130,8 +144,12 @@ class Parameter(object):
                     "mismatch %s vs %s" % (self.name, data.shape, self.shape))
         self.shape = tuple(data.shape)
         self._deferred_init = ()
-        self._data = data.astype(self.dtype) \
-            if np.dtype(data.dtype) != np.dtype(self.dtype) else data
+        if np.dtype(data.dtype) != np.dtype(self.dtype):
+            data = data.astype(self.dtype)
+        # keep the mesh-replication invariant: a multi-ctx parameter must
+        # stay replicated after loading from a (single-device) checkpoint
+        self._data = self._place(data, getattr(self, "_ctx_list", None)
+                                 or (ctx if isinstance(ctx, list) else None))
         if self._grad_req != "null":
             self._init_grad()
 
@@ -171,6 +189,8 @@ class Parameter(object):
 
     def list_ctx(self) -> List[Context]:
         self._check_initialized()
+        if getattr(self, "_ctx_list", None):
+            return list(self._ctx_list)
         return [self._data.context]
 
     def set_data(self, data):
@@ -204,8 +224,9 @@ class Parameter(object):
     def reset_ctx(self, ctx):
         """Move to a new context (reference: parameter.py reset_ctx)."""
         if self._data is not None:
-            self._data = self._data.copyto(ctx if isinstance(ctx, Context)
-                                           else ctx[0])
+            ctx_list = [ctx] if isinstance(ctx, Context) else list(ctx)
+            self._data = self._place(self._data.copyto(ctx_list[0]),
+                                     ctx_list)
             if self._grad_req != "null":
                 self._init_grad()
 

@@ -49,14 +49,32 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    """Split and load to each context (reference: utils.py
-    split_and_load)."""
+    """Load a batch for the given contexts (reference: utils.py
+    split_and_load returns one slice per context).
+
+    TPU form: with several contexts the batch becomes ONE array sharded
+    over a data-parallel mesh — returned as a single-element list so the
+    reference's ``for x in split_and_load(...)`` loop runs once and GSPMD
+    executes it on every device. Parameters initialized with the same
+    context list are mesh-replicated (gluon.Parameter._finish_init), so
+    XLA inserts the gradient psum the reference's kvstore did manually.
+    """
     if not isinstance(data, nd.NDArray):
         data = nd.array(data, ctx=ctx_list[0])
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
-    slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+    if data.shape[batch_axis] % len(ctx_list) != 0:
+        # a GSPMD-sharded array cannot hold uneven per-device slices (the
+        # reference's even_split=False form) — pad the batch instead, e.g.
+        # DataIter(last_batch_handle="pad")
+        raise ValueError(
+            "data with shape %s cannot be sharded over %d contexts along "
+            "axis %d: mesh data parallelism needs a divisible batch (pad "
+            "the last batch, e.g. last_batch_handle='pad')."
+            % (data.shape, len(ctx_list), batch_axis))
+    from ..parallel.mesh import data_parallel_mesh, shard_batch
+    mesh = data_parallel_mesh(ctx_list)
+    return [nd.NDArray(shard_batch(mesh, data.data, batch_dim=batch_axis))]
 
 
 def clip_global_norm(arrays, max_norm):

@@ -77,10 +77,12 @@ def _as_step_inputs(inputs, length, layout, input_prefix=""):
     return inputs
 
 
-def _merge_time(outputs):
-    """Stack per-step outputs into one [N, T, C] symbol."""
-    return symbol.Concat(*[symbol.expand_dims(o, axis=1) for o in outputs],
-                         dim=1)
+def _merge_time(outputs, t_axis=1):
+    """Stack per-step outputs into one symbol with time at ``t_axis``
+    (axis 1 = NTC, axis 0 = TNC) so a stacked layer can re-split what the
+    previous layer merged under the same layout."""
+    return symbol.Concat(*[symbol.expand_dims(o, axis=t_axis)
+                           for o in outputs], dim=t_axis)
 
 
 class BaseRNNCell(object):
@@ -187,7 +189,7 @@ class BaseRNNCell(object):
             out, states = self(inputs[t], states)
             outputs.append(out)
         if merge_outputs:
-            outputs = _merge_time(outputs)
+            outputs = _merge_time(outputs, max(layout.find("T"), 0))
         return outputs, states
 
 
@@ -712,5 +714,5 @@ class BidirectionalCell(BaseRNNCell):
                           name="%st%d" % (self._output_prefix, t))
             for t, (f, b) in enumerate(zip(f_out, reversed(b_out)))]
         if merge_outputs:
-            outputs = _merge_time(outputs)
+            outputs = _merge_time(outputs, max(layout.find("T"), 0))
         return outputs, f_states + b_states

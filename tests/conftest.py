@@ -34,6 +34,10 @@ _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# cache HLO only — the AOT kernel cache embeds exact host CPU features and
+# spews loader errors when they drift (e.g. cache written under a different
+# XLA host-feature fingerprint)
+jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
 
 import numpy as _np  # noqa: E402
 import pytest  # noqa: E402
