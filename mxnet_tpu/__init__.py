@@ -36,6 +36,7 @@ from . import lr_scheduler
 from . import callback
 from . import model
 from . import io
+from . import image
 from . import rtc
 from . import contrib
 from . import recordio

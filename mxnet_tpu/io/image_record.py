@@ -62,7 +62,7 @@ class ImageRecordIter(DataIter):
                  preprocess_threads: int = 4, prefetch_buffer: int = 4,
                  round_batch: bool = True, data_name: str = "data",
                  label_name: str = "softmax_label", dtype="float32",
-                 silent: bool = False, **kwargs):
+                 silent: bool = False, aug_list=None, **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = label_width
@@ -78,6 +78,7 @@ class ImageRecordIter(DataIter):
             except Exception:
                 self._params["mean_arr"] = None
         self._rng = np.random.RandomState(seed)
+        self._aug_list = aug_list      # mx.image Augmenter pipeline override
         self._path = path_imgrec
 
         # index the record offsets once so shuffle is a permutation of offsets
@@ -109,6 +110,24 @@ class ImageRecordIter(DataIter):
     def _decode_and_augment(self, buf: bytes):
         import cv2
         header, img = self._unpack(buf)
+        if self._aug_list is not None:
+            # composable mx.image.Augmenter pipeline replaces the built-in
+            # crop/mirror/normalize params (reference: ImageIter aug_list)
+            if img.ndim == 2:
+                img = img[:, :, None]
+            out = np.ascontiguousarray(img[:, :, ::-1])   # BGR -> RGB
+            for aug in self._aug_list:
+                out = aug(out)
+            if hasattr(out, "asnumpy"):
+                out = out.asnumpy()
+            arr = np.asarray(out, np.float32)
+            c, th, tw = self.data_shape
+            if arr.shape[:2] != (th, tw):
+                raise ValueError(
+                    "aug_list produced image of shape %s, data_shape wants "
+                    "%dx%d — add a crop/resize augmenter"
+                    % (arr.shape, th, tw))
+            return arr.transpose(2, 0, 1), self._label_of(header)
         p = self._params
         if p["resize"] > 0:
             h, w = img.shape[:2]
@@ -143,11 +162,14 @@ class ImageRecordIter(DataIter):
         if p["scale"] != 1.0:
             img = img * p["scale"]
         img = img.transpose(2, 0, 1)  # HWC -> CHW
+        return img, self._label_of(header)
+
+    def _label_of(self, header):
         label = header.label
         if isinstance(label, np.ndarray):
             label = label[:self.label_width] if self.label_width > 1 \
                 else float(label[0])
-        return img, label
+        return label
 
     @staticmethod
     def _unpack(buf):
@@ -164,38 +186,45 @@ class ImageRecordIter(DataIter):
             if not self._alive:
                 break
             self._reset_evt.clear()
-            order = self._order.copy()
-            if self._shuffle:
-                self._rng.shuffle(order)
-            rec = MXRecordIO(self._path, "r")
-            bufs = []
-            # stream sequentially; shuffled access uses offsets
-            for i in order:
-                rec.handle.seek(self._offsets[i])
-                b = rec.read()
-                if b is not None:
-                    bufs.append(b)
-                if len(bufs) == self.batch_size:
-                    futures = [pool.submit(self._decode_and_augment, x)
-                               for x in bufs]
-                    imgs, labels = zip(*[f.result() for f in futures])
-                    if not self._alive:
-                        break
-                    self._batch_queue.put(("data", np.stack(imgs),
-                                           np.asarray(labels, np.float32), 0))
-                    bufs = []
-            rec.close()
-            if bufs and self._alive:
-                pad = self.batch_size - len(bufs)
+            try:
+                self._produce_epoch(pool)
+            except Exception as exc:   # surface to the consumer, don't hang
+                if self._alive:
+                    self._batch_queue.put(("error", exc, None, 0))
+
+    def _produce_epoch(self, pool):
+        order = self._order.copy()
+        if self._shuffle:
+            self._rng.shuffle(order)
+        rec = MXRecordIO(self._path, "r")
+        bufs = []
+        # stream sequentially; shuffled access uses offsets
+        for i in order:
+            rec.handle.seek(self._offsets[i])
+            b = rec.read()
+            if b is not None:
+                bufs.append(b)
+            if len(bufs) == self.batch_size:
                 futures = [pool.submit(self._decode_and_augment, x)
                            for x in bufs]
                 imgs, labels = zip(*[f.result() for f in futures])
-                imgs = list(imgs) + [imgs[-1]] * pad
-                labels = list(labels) + [labels[-1]] * pad
+                if not self._alive:
+                    break
                 self._batch_queue.put(("data", np.stack(imgs),
-                                       np.asarray(labels, np.float32), pad))
-            if self._alive:
-                self._batch_queue.put(("stop", None, None, 0))
+                                       np.asarray(labels, np.float32), 0))
+                bufs = []
+        rec.close()
+        if bufs and self._alive:
+            pad = self.batch_size - len(bufs)
+            futures = [pool.submit(self._decode_and_augment, x)
+                       for x in bufs]
+            imgs, labels = zip(*[f.result() for f in futures])
+            imgs = list(imgs) + [imgs[-1]] * pad
+            labels = list(labels) + [labels[-1]] * pad
+            self._batch_queue.put(("data", np.stack(imgs),
+                                   np.asarray(labels, np.float32), pad))
+        if self._alive:
+            self._batch_queue.put(("stop", None, None, 0))
 
     # ------------------------------------------------------------ DataIter
     @property
@@ -219,6 +248,8 @@ class ImageRecordIter(DataIter):
 
     def next(self):
         kind, imgs, labels, pad = self._batch_queue.get()
+        if kind == "error":
+            raise imgs                # exception from the loader thread
         if kind == "stop":
             raise StopIteration
         return DataBatch(data=[nd.array(imgs.astype(self._dtype),
