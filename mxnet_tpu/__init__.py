@@ -37,6 +37,11 @@ from . import callback
 from . import model
 from . import io
 from . import image
+from . import profiler
+from . import monitor
+from . import monitor as mon
+from . import visualization
+from . import visualization as viz
 from . import rtc
 from . import contrib
 from . import recordio

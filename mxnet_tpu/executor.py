@@ -88,21 +88,7 @@ def graph_function(symbol, node_device=None):
                 vals[(id(node), 0)] = v
                 continue
             ins = [vals[(id(n), i)] for n, i in node.inputs]
-            attrs = dict(node.attrs)
-            attrs.pop("name", None)
-            if _accepts_is_train(node.op):
-                attrs["_is_train"] = is_train
-            if node.op.needs_rng:
-                attrs["_rng"] = jax.random.fold_in(key, idx)
-            if node_device is not None:
-                dev = node_device(node)
-                if dev is not None:
-                    # boundary transfer: inputs produced on another group's
-                    # device hop here (the reference's copy node)
-                    ins = [jax.device_put(x, dev) for x in ins]
-            outs = node.op.fn(*ins, **attrs)
-            if not isinstance(outs, tuple):
-                outs = (outs,)
+            outs = _run_node(node, ins, key, idx, is_train, node_device)
             for i, o in enumerate(outs):
                 vals[(id(node), i)] = o
             n_aux = node.op.num_aux
@@ -114,6 +100,27 @@ def graph_function(symbol, node_device=None):
         return outputs, new_aux
 
     return fn
+
+
+def _run_node(node, ins, key, idx, is_train, node_device=None):
+    """Execute one graph node: implicit attrs (_is_train, per-node RNG),
+    group2ctx boundary transfer, tuple-normalized outputs. The single
+    definition both graph_function and Executor.monitor_values dispatch
+    through, so monitored values cannot drift from executed values."""
+    attrs = dict(node.attrs)
+    attrs.pop("name", None)
+    if _accepts_is_train(node.op):
+        attrs["_is_train"] = is_train
+    if node.op.needs_rng:
+        attrs["_rng"] = jax.random.fold_in(key, idx)
+    if node_device is not None:
+        dev = node_device(node)
+        if dev is not None:
+            # boundary transfer: inputs produced on another group's device
+            # hop here (the reference's copy node)
+            ins = [jax.device_put(x, dev) for x in ins]
+    outs = node.op.fn(*ins, **attrs)
+    return outs if isinstance(outs, tuple) else (outs,)
 
 
 def _normalize_dict(values, names, what):
@@ -280,7 +287,10 @@ class Executor:
                 else jnp.asarray(v)
             self.arg_dict[k]._version += 1
         arg_vals, aux_vals, key = self._gather()
+        self._last_is_train = bool(is_train)
         if is_train and self._wrt:
+            # deferred: backward() runs the fused fwd+bwd once; forcing
+            # outputs here (e.g. for a monitor) would double the forward
             self._pending = (arg_vals, aux_vals, key)
             self._outputs = None
         else:
@@ -288,8 +298,6 @@ class Executor:
                                           bool(is_train))
             self._commit(outs, new_aux)
             self._pending = None
-        if self._monitor_callback:
-            self._run_monitor()
         return self.outputs
 
     def backward(self, out_grads=None) -> None:
@@ -308,8 +316,18 @@ class Executor:
         else:
             heads = [out_grads.data if isinstance(out_grads, _nd.NDArray)
                      else jnp.asarray(out_grads)]
-        outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals, key,
-                                                 heads)
+        from . import profiler as _profiler
+        if _profiler.state() == "run":
+            import time as _time
+            _t0 = _time.perf_counter()
+            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
+                                                     key, heads)
+            jax.block_until_ready(outs)
+            _profiler.record_event("graph_fwd_bwd", _t0,
+                                   _time.perf_counter(), "graph")
+        else:
+            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
+                                                     key, heads)
         self._commit(outs, new_aux)
         self._pending = None
         for n, g in grads.items():
@@ -329,6 +347,13 @@ class Executor:
             a = self.aux_dict[n]
             a._data = v
             a._version += 1
+        # monitor fires when real outputs materialize — deduped by step so
+        # a forward-then-backward pair (two commits of the same step)
+        # reports once
+        if self._monitor_callback and \
+                getattr(self, "_mon_step", -1) != self._step:
+            self._mon_step = self._step
+            self._run_monitor()
 
     @property
     def outputs(self) -> List[_nd.NDArray]:
@@ -405,6 +430,35 @@ class Executor:
                         shared_exec=self)
 
     # ------------------------------------------------------------ monitor
+    def monitor_values(self):
+        """Eagerly interpret the graph with the current bindings, yielding
+        (node_output_name, NDArray) for EVERY node — the per-op stat tap
+        the reference's MonitorExecution installs on each engine op
+        (src/executor/graph_executor.cc monitor_callback_). Debug path:
+        runs outside the fused jit with the SAME per-node dispatch
+        (_run_node) and the last forward's is_train/RNG key; aux states
+        reflect the post-commit values (approximate for BatchNorm moving
+        stats, exact for everything else)."""
+        from .symbol.symbol import _topo_order
+        nodes = _topo_order(self._symbol._entries)
+        key = jax.random.fold_in(self._base_key, self._step)
+        is_train = getattr(self, "_last_is_train", True)
+        node_device = self._node_device_fn()
+        vals = {}
+        for idx, node in enumerate(nodes):
+            if node.is_variable:
+                src_nd = self.arg_dict.get(node.name)
+                if src_nd is None:
+                    src_nd = self.aux_dict.get(node.name)
+                vals[(id(node), 0)] = src_nd.data
+                continue
+            ins = [vals[(id(n), i)] for n, i in node.inputs]
+            outs = _run_node(node, ins, key, idx, is_train, node_device)
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+                suffix = "_output" if len(outs) == 1 else "_output%d" % i
+                yield node.name + suffix, _nd.NDArray(o)
+
     def set_monitor_callback(self, callback) -> None:
         """(reference: MXExecutorSetMonitorCallback / Monitor support —
         graph_executor.cc:1209 ExecuteMonCallback). Called as

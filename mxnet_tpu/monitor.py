@@ -1,0 +1,90 @@
+"""``mx.mon.Monitor`` — per-op output statistics during training.
+
+Reference: ``python/mxnet/monitor.py:33`` — Monitor(interval, stat_func,
+pattern, sort); ``install`` hooks the executor's monitor callback, ``tic``
+arms collection for the coming batch, ``toc``/``toc_print`` drain the
+queue. The executor tap is ``Executor.monitor_values`` (every node output,
+the per-engine-op callback of the reference) filtered by ``pattern``.
+"""
+from __future__ import annotations
+
+import re
+from math import sqrt
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    """(reference: monitor.py:33)."""
+
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        if stat_func is None:
+            def stat_func(x):
+                # |x|.mean() — the reference's default "asum/size" stat
+                return np.abs(np.asarray(x)).mean()
+        self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.queue: List[Tuple[int, str, object]] = []
+        self.step = 0
+        self.activated = False
+        self.exes: List[object] = []
+
+    def stat_helper(self, name, arr):
+        """Executor callback (reference: monitor.py stat_helper)."""
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        if hasattr(arr, "asnumpy"):
+            arr = arr.asnumpy()
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe, monitor_all: bool = True):
+        """Attach to an executor (reference: monitor.py install).
+
+        ``monitor_all=True`` (default) collects EVERY node's output via the
+        executor's eager re-interpretation at ``toc`` time;
+        ``monitor_all=False`` taps only the graph outputs through the
+        forward-time callback. The two modes are exclusive so a stat is
+        never reported twice for one tensor."""
+        if not monitor_all:
+            exe.set_monitor_callback(self.stat_helper)
+        self.exes.append((exe, monitor_all))
+        return exe
+
+    def tic(self):
+        """Arm collection if this step hits the interval (reference:
+        monitor.py tic)."""
+        if self.step % self.interval == 0:
+            for exe, _ in self.exes:
+                for arr in getattr(exe, "arg_arrays", []):
+                    arr.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        """Drain collected stats (reference: monitor.py toc)."""
+        if not self.activated:
+            return []
+        for exe, monitor_all in self.exes:
+            if monitor_all and hasattr(exe, "monitor_values"):
+                for name, arr in exe.monitor_values():
+                    self.stat_helper(name, arr)
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v in self.queue:
+            res.append((n, k, str(v)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """(reference: monitor.py toc_print)."""
+        for n, k, v in self.toc():
+            print("Batch: %7d %30s %s" % (n, k, v))

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd as _autograd
+from .. import profiler as _profiler
 from .. import random as _random
 from ..base import MXNetError
 from ..context import Context, current_context
@@ -405,12 +406,21 @@ def imperative_invoke(op: OpDef, *args, out=None, ctx=None, **attrs):
         in_keys = [(a._uid, a._version) for a in nd_args]
         in_consts = [a._data for a in nd_args]
 
+    _profiling = _profiler.state() == "run"
+    if _profiling:
+        import time as _time
+        _t0 = _time.perf_counter()
     if op.num_inputs == 0 and not nd_args:
         dev = (ctx or current_context()).jax_device
         with jax.default_device(dev):
             outputs = op.fn(*jax_args, **attrs)
     else:
         outputs = op.fn(*jax_args, **attrs)
+    if _profiling:
+        # block so the event duration is real device time (the reference's
+        # engine sync-dispatch profiling mode)
+        jax.block_until_ready(outputs)
+        _profiler.record_event(op.name, _t0, _time.perf_counter(), "op")
     single = not isinstance(outputs, tuple)
     if single:
         outputs = (outputs,)
@@ -475,8 +485,10 @@ def waitall() -> None:
     (reference: Engine::WaitForAll via MXNDArrayWaitAll;
     python/mxnet/ndarray.py:131). XLA executes per-device streams in order,
     so enqueueing one token computation per device and blocking on them
-    flushes all previously dispatched work."""
-    tokens = [jax.device_put(jnp.zeros(()), d) for d in jax.devices()]
+    flushes all previously dispatched work. Local devices only — under
+    jax.distributed the global list includes other processes' devices,
+    which this process cannot address."""
+    tokens = [jax.device_put(jnp.zeros(()), d) for d in jax.local_devices()]
     for t in tokens:
         t.block_until_ready()
 

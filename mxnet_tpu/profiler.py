@@ -1,0 +1,128 @@
+"""``mx.profiler`` — execution tracing.
+
+Reference: ``python/mxnet/profiler.py`` (profiler_set_config:27,
+profiler_set_state:48, dump_profile:64) writing the chrome://tracing JSON
+the engine emits in ``src/engine/profiler.cc:127-179``.
+
+Two layers here:
+
+* A framework-level event recorder: while the state is ``run``, every
+  imperative op dispatch and every executor graph launch logs a
+  chrome-trace complete event (synchronized — the op is blocked on so the
+  duration is real device time, the profiler twin of the reference's
+  engine sync mode). ``dump_profile()`` writes the standard
+  ``{"traceEvents": [...]}`` JSON loadable in chrome://tracing / Perfetto.
+* The XLA-level profiler: ``start_xla_trace(logdir)`` /
+  ``stop_xla_trace()`` wrap ``jax.profiler`` for TensorBoard-grade HLO
+  timelines on real hardware.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import List, Optional
+
+__all__ = [
+    "profiler_set_config", "profiler_set_state", "dump_profile",
+    "set_config", "set_state", "dump", "pause", "resume",
+    "start_xla_trace", "stop_xla_trace", "record_event", "state",
+]
+
+_lock = threading.Lock()
+_state = "stop"
+_filename = "profile.json"
+_events: List[dict] = []
+_t0 = time.perf_counter()
+
+
+def state() -> str:
+    return _state
+
+
+def set_config(filename: str = "profile.json", profile_all: bool = True,
+               **_ignored) -> None:
+    """(reference: profiler.py:27 profiler_set_config — mode knobs beyond
+    the filename collapse: there is no per-subsystem engine here)."""
+    global _filename
+    _filename = filename
+
+
+def set_state(st: str = "stop") -> None:
+    """'run' starts recording, 'stop' stops (reference: profiler.py:48)."""
+    global _state
+    assert st in ("run", "stop"), st
+    _state = st
+
+
+def pause() -> None:
+    set_state("stop")
+
+
+def resume() -> None:
+    set_state("run")
+
+
+def record_event(name: str, t_start: float, t_end: float,
+                 category: str = "op") -> None:
+    """Append one chrome-trace complete event (timestamps from
+    time.perf_counter())."""
+    if _state != "run":
+        return
+    with _lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": (t_start - _t0) * 1e6, "dur": (t_end - t_start) * 1e6,
+            "pid": 0, "tid": threading.get_ident() % 100000,
+        })
+
+
+class record(object):
+    """Context manager: time a region into the profile."""
+
+    def __init__(self, name: str, category: str = "region"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self._t = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        record_event(self.name, self._t, time.perf_counter(),
+                     self.category)
+        return False
+
+
+def dump(finished: bool = True) -> str:
+    """Write the chrome-trace JSON; returns the path (reference:
+    profiler.py:64 dump_profile -> engine Profiler::DumpProfile,
+    src/engine/profiler.cc:127-179)."""
+    with _lock:
+        payload = {"traceEvents": list(_events),
+                   "displayTimeUnit": "ms"}
+        if finished:
+            _events.clear()
+    with open(_filename, "w") as f:
+        json.dump(payload, f)
+    return _filename
+
+
+# reference-compatible names
+profiler_set_config = set_config
+profiler_set_state = set_state
+dump_profile = dump
+
+
+# ------------------------------------------------------------- XLA layer
+
+
+def start_xla_trace(logdir: str) -> None:
+    """Start a jax/XLA device trace (TensorBoard format)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_xla_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()

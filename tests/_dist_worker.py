@@ -12,6 +12,13 @@ import sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax  # noqa: E402
+
+# the accelerator plugin can rewrite JAX_PLATFORMS at startup; without the
+# config override both workers intermittently grab the one real TPU over
+# its tunnel and deadlock the coordinator handshake
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 
 

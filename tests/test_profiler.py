@@ -1,0 +1,105 @@
+"""Profiler, Monitor, visualization (reference tests:
+tests/python/unittest/test_profiler.py + monitor usage in test_monitor.py)."""
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_profiler_records_ops_and_dumps_chrome_trace():
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "prof.json")
+        mx.profiler.set_config(filename=path)
+        mx.profiler.set_state("run")
+        a = mx.nd.uniform(shape=(8, 8))
+        b = mx.nd.dot(a, a)
+        (b + 1).asnumpy()
+        with mx.profiler.record("my_region"):
+            mx.nd.sum(b).asnumpy()
+        mx.profiler.set_state("stop")
+        out = mx.profiler.dump()
+        assert out == path
+        trace = json.load(open(path))
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "dot" in names
+        assert "my_region" in names
+        for e in trace["traceEvents"]:
+            assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_profiler_off_records_nothing():
+    mx.profiler.set_state("stop")
+    mx.nd.uniform(shape=(4, 4)).asnumpy()
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "p.json")
+        mx.profiler.set_config(filename=path)
+        mx.profiler.dump()
+        assert json.load(open(path))["traceEvents"] == []
+
+
+def test_monitor_collects_per_op_stats():
+    sym = _mlp()
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(4, 6),
+                         softmax_label=(4,))
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.RandomState(0).rand(*arr.shape)
+    ex.arg_dict["data"][:] = np.random.RandomState(1).rand(4, 6)
+    ex.arg_dict["softmax_label"][:] = np.array([0, 1, 0, 1], np.float32)
+
+    mon = mx.mon.Monitor(interval=1, pattern=".*fc.*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    stats = mon.toc()
+    names = [k for _, k, _ in stats]
+    assert any("fc1" in n for n in names)
+    assert any("fc2" in n for n in names)
+    assert not any("relu" in n for n in names)   # pattern filtered
+    for _, _, v in stats:
+        assert float(v) >= 0
+
+
+def test_monitor_through_module_fit():
+    """install_monitor has a real Monitor to receive now (VERDICT 5.1)."""
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (40, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=20, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mon = mx.mon.Monitor(interval=2)
+    mod.fit(it, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1}, num_epoch=1,
+            monitor=mon)
+    assert mon.step > 0
+
+
+def test_print_summary_counts_params(capsys):
+    sym = _mlp()
+    total = mx.viz.print_summary(sym, shape={"data": (4, 6)})
+    # fc1: 6*8+8, fc2: 8*2+2
+    assert total == 6 * 8 + 8 + 8 * 2 + 2
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params" in out
+
+
+def test_plot_network_builds_digraph():
+    try:
+        import graphviz  # noqa: F401
+    except ImportError:
+        import pytest
+        pytest.skip("graphviz not installed")
+    dot = mx.viz.plot_network(_mlp(), shape={"data": (4, 6)})
+    src = dot.source
+    assert "fc1" in src and "softmax" in src
