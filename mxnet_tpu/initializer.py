@@ -175,26 +175,40 @@ class Mixed(object):
                          "adding a \".*\" pattern at the end." % name)
 
 
-@register
-class Zero(Initializer):
+class _FillInitializer(Initializer):
+    """Fill with one value for ANY name — but a per-variable ``init=`` attr
+    still wins, so Variable(init=Normal()) is honored even when the global
+    initializer is Zero (the attr dispatch in Initializer.__call__)."""
+
+    _fill_value = 0.0
+
     def __call__(self, desc, arr):
-        arr[:] = 0.0
+        if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
+            return Initializer.__call__(self, desc, arr)
+        arr[:] = self._fill_value
+
+    # the __init__-attr dispatch routes through _init_weight (reference:
+    # initializer.py Zero/One define _init_weight)
+    def _init_weight(self, name, arr):
+        arr[:] = self._fill_value
 
 
 @register
-class One(Initializer):
-    def __call__(self, desc, arr):
-        arr[:] = 1.0
+class Zero(_FillInitializer):
+    _fill_value = 0.0
 
 
 @register
-class Constant(Initializer):
+class One(_FillInitializer):
+    _fill_value = 1.0
+
+
+@register
+class Constant(_FillInitializer):
     def __init__(self, value=0.0):
         super().__init__(value=value)
         self.value = value
-
-    def __call__(self, desc, arr):
-        arr[:] = self.value
+        self._fill_value = value
 
 
 @register

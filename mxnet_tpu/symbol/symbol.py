@@ -568,6 +568,21 @@ def _infer_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]],
 
     resolved = dict(known)
     batch_size = resolved.pop("__batch_size__", None)
+    if batch_size is None:
+        # derive the batch hint from the caller-provided input shapes:
+        # prefer the canonical "data" input's leading dim (NT/NTC layouts;
+        # pass __batch_size__ explicitly for time-major data)
+        data_like = [(n, s) for n, s in resolved.items()
+                     if s and not str(n).endswith(
+                         ("weight", "bias", "gamma", "beta",
+                          "moving_mean", "moving_var"))]
+        for n, s in data_like:
+            if n == "data":
+                batch_size = s[0]
+                break
+        else:
+            if data_like:
+                batch_size = data_like[0][1][0]
     # shapes pinned on Variables via shape= attr; wildcard (0) dims stand
     # for the batch dimension (reference convention: state_info shapes are
     # (0, H) with __layout__ marking the N axis) and resolve from the

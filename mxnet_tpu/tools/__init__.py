@@ -1,0 +1,1 @@
+"""Packaged CLI tools (reference: tools/ — im2rec, launch)."""
