@@ -35,6 +35,7 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import model
+from . import config
 from . import io
 from . import image
 from . import profiler
@@ -54,6 +55,8 @@ from . import gluon
 from . import models
 from . import rnn
 from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constant
+
+config._apply_import_knobs()
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,137 @@
+"""``mx.config`` — the environment-variable knob layer.
+
+Reference: the ~30 ``MXNET_*`` env vars of ``docs/how_to/env_var.md:8-125``
+backed by ``dmlc::Parameter`` reflection. Same surface here: typed,
+documented knobs read from the environment with runtime override, each
+wired to a real control point (not parity theater):
+
+* ``MXNET_ENGINE_TYPE=NaiveEngine`` — synchronous dispatch: every
+  imperative op blocks until its result is ready, serializing execution
+  exactly like the reference's debug engine (env_var.md: the race-
+  detection/debug mode, SURVEY §5.2). Default ``ThreadedEngine`` keeps
+  XLA's async dispatch.
+* ``MXNET_CPU_WORKER_NTHREADS`` — decode/augment worker threads of the
+  record iterators (reference: same knob feeding the IO thread pool).
+* ``MXNET_PREFETCH_BUFFER`` — batches buffered ahead by the record
+  iterators (reference: iter_prefetcher.h depth).
+* ``MXNET_EXEC_ENABLE_REMAT`` — rematerialize the fused train step's
+  forward under ``jax.checkpoint``: trades recompute FLOPs for activation
+  HBM (the TPU form of the reference's memory-saving exec knobs,
+  MXNET_EXEC_ENABLE_INPLACE / bulk-exec family).
+* ``MXNET_COMPILATION_CACHE_DIR`` — persistent XLA compile cache
+  directory (reference: MXNET_CUDNN_AUTOTUNE et al. cache compiled
+  choices across runs).
+* ``MXNET_PROFILER_AUTOSTART`` — start the profiler at import
+  (reference: same knob).
+* ``MXNET_KVSTORE_HEARTBEAT_STALE_SECS`` — seconds without a heartbeat
+  before a worker counts as dead (reference: ps-lite
+  PS_HEARTBEAT_TIMEOUT feeding get_num_dead_node, SURVEY §5.3).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+__all__ = ["get", "set", "describe", "register", "KNOBS"]
+
+
+class _Knob:
+    def __init__(self, name: str, typ: Callable, default: Any, doc: str):
+        self.name = name
+        self.typ = typ
+        self.default = default
+        self.doc = doc
+
+
+KNOBS: Dict[str, _Knob] = {}
+_overrides: Dict[str, Any] = {}
+_listeners: Dict[str, list] = {}
+
+
+def register(name: str, typ, default, doc: str) -> None:
+    KNOBS[name] = _Knob(name, typ, default, doc)
+
+
+def on_change(name: str, fn: Callable[[Any], None]) -> None:
+    """Call ``fn(new_value)`` whenever ``set``/``reset`` changes the knob —
+    lets hot paths cache a knob as a module-level constant instead of
+    re-reading the environment per call."""
+    KNOBS[name]   # raise on unknown
+    _listeners.setdefault(name, []).append(fn)
+
+
+def _notify(name: str) -> None:
+    for fn in _listeners.get(name, ()):
+        fn(get(name))
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+register("MXNET_ENGINE_TYPE", str, "ThreadedEngine",
+         "NaiveEngine = synchronous op dispatch (debug/race detection); "
+         "ThreadedEngine = XLA async dispatch")
+register("MXNET_CPU_WORKER_NTHREADS", int, 4,
+         "decode/augment worker threads in record iterators")
+register("MXNET_PREFETCH_BUFFER", int, 4,
+         "batches buffered ahead by record iterators")
+register("MXNET_EXEC_ENABLE_REMAT", _parse_bool, False,
+         "jax.checkpoint the fused train step's forward (less HBM, more "
+         "FLOPs)")
+register("MXNET_COMPILATION_CACHE_DIR", str, "",
+         "persistent XLA compile cache directory")
+register("MXNET_PROFILER_AUTOSTART", _parse_bool, False,
+         "start mx.profiler at import")
+register("MXNET_KVSTORE_HEARTBEAT_STALE_SECS", float, 20.0,
+         "heartbeat staleness threshold for get_num_dead_node")
+
+
+def get(name: str):
+    """Current value: runtime override > environment > default."""
+    knob = KNOBS[name]
+    if name in _overrides:
+        return _overrides[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return knob.default
+    return knob.typ(raw)
+
+
+def set(name: str, value) -> None:     # noqa: A001 (reference-style name)
+    """Runtime override (takes precedence over the environment)."""
+    knob = KNOBS[name]
+    _overrides[name] = knob.typ(value)
+    _notify(name)
+
+
+def reset(name: str) -> None:
+    """Drop a runtime override, reverting to environment/default."""
+    _overrides.pop(name, None)
+    _notify(name)
+
+
+def describe() -> str:
+    """Human-readable table of every knob, its value and source
+    (reference: env_var.md as a runtime query)."""
+    lines = []
+    for name, knob in sorted(KNOBS.items()):
+        src = "override" if name in _overrides else \
+            ("env" if name in os.environ else "default")
+        lines.append("%-36s %-22r (%s)  %s"
+                     % (name, get(name), src, knob.doc))
+    return "\n".join(lines)
+
+
+def _apply_import_knobs() -> None:
+    """Knobs that act once at package import."""
+    cache_dir = get("MXNET_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if get("MXNET_PROFILER_AUTOSTART"):
+        from . import profiler
+        profiler.set_state("run")

@@ -59,7 +59,8 @@ class ImageRecordIter(DataIter):
                  std_r: float = 1.0, std_g: float = 1.0, std_b: float = 1.0,
                  scale: float = 1.0, max_random_scale: float = 1.0,
                  min_random_scale: float = 1.0, seed: int = 0,
-                 preprocess_threads: int = 4, prefetch_buffer: int = 4,
+                 preprocess_threads: Optional[int] = None,
+                 prefetch_buffer: Optional[int] = None,
                  round_batch: bool = True, data_name: str = "data",
                  label_name: str = "softmax_label", dtype="float32",
                  silent: bool = False, aug_list=None, **kwargs):
@@ -94,6 +95,11 @@ class ImageRecordIter(DataIter):
         self._order = np.arange(len(self._offsets))
         self._shuffle = shuffle
 
+        from .. import config as _config
+        if preprocess_threads is None:
+            preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS")
+        if prefetch_buffer is None:
+            prefetch_buffer = _config.get("MXNET_PREFETCH_BUFFER")
         self._n_threads = max(1, int(preprocess_threads))
         self._prefetch = max(2, int(prefetch_buffer))
         self._epoch_queue: "queue.Queue" = queue.Queue()

@@ -180,9 +180,9 @@ class KVStore(object):
         processes exist in the SPMD design; kept for API parity."""
 
     def get_num_dead_node(self, node_id: int, timeout: int = 0) -> int:
-        """(reference: kvstore.h:287 — ps-lite heartbeat probe). The JAX
-        distributed runtime surfaces failures as errors, not liveness polls;
-        a live store reports zero dead nodes."""
+        """(reference: kvstore.h:287 — ps-lite heartbeat probe). In a
+        single process there is nothing to probe and the correct answer is
+        zero; DistKVStore overrides this with a real heartbeat check."""
         return 0
 
     @staticmethod
@@ -216,6 +216,17 @@ class DistKVStore(KVStore):
         super().__init__(kind)
         from .parallel import dist
         self._dist = dist
+        # liveness heartbeat via the coordinator's KV store (reference:
+        # ps-lite worker heartbeats, SURVEY §5.3 failure detection)
+        dist.heartbeat_start()
+
+    def get_num_dead_node(self, node_id: int, timeout: int = 0) -> int:
+        """Workers with a missing/stale heartbeat (reference:
+        kvstore.h:287 over ps-lite's scheduler heartbeat table)."""
+        from . import config as _config
+        stale = _config.get("MXNET_KVSTORE_HEARTBEAT_STALE_SECS")
+        return self._dist.num_dead_nodes(
+            stale_after=stale, timeout_ms=max(int(timeout) * 1000, 1000))
 
     @property
     def rank(self) -> int:

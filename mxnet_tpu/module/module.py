@@ -432,11 +432,19 @@ class Module(BaseModule):
                     is_leaf=lambda x: isinstance(x, nd.NDArray) or x is None)
             return states
 
+        from .. import config as _config
+        remat = _config.get("MXNET_EXEC_ENABLE_REMAT")
+
         def step(params, states, aux, inputs, frozen_vals, key, lr, t):
             def loss_fn(p):
                 outs, new_aux = fn({**p, **inputs, **frozen_vals}, aux, key,
                                    True)
                 return outs, new_aux
+
+            if remat:
+                # trade forward recompute for activation HBM
+                # (MXNET_EXEC_ENABLE_REMAT; jax.checkpoint)
+                loss_fn = jax.checkpoint(loss_fn)
 
             (outs, new_aux), vjp = jax.vjp(loss_fn, params)
             cts = [jnp.ones_like(o) for o in outs]

@@ -30,8 +30,20 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd as _autograd
+from .. import config as _config
 from .. import profiler as _profiler
 from .. import random as _random
+
+# hot-path cache of the engine knob; config.set/reset refreshes it
+_SYNC_DISPATCH = _config.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
+
+
+def _refresh_engine(value):
+    global _SYNC_DISPATCH
+    _SYNC_DISPATCH = value == "NaiveEngine"
+
+
+_config.on_change("MXNET_ENGINE_TYPE", _refresh_engine)
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ops import OP_REGISTRY, OpDef, get_op
@@ -421,6 +433,10 @@ def imperative_invoke(op: OpDef, *args, out=None, ctx=None, **attrs):
         # engine sync-dispatch profiling mode)
         jax.block_until_ready(outputs)
         _profiler.record_event(op.name, _t0, _time.perf_counter(), "op")
+    elif _SYNC_DISPATCH:
+        # debug engine: serialize dispatch so failures surface at the op
+        # that caused them (reference env_var.md MXNET_ENGINE_TYPE)
+        jax.block_until_ready(outputs)
     single = not isinstance(outputs, tuple)
     if single:
         outputs = (outputs,)

@@ -215,3 +215,91 @@ def barrier():
     if num_workers() == 1:
         return
     jax.block_until_ready(allreduce_sum(jax.numpy.zeros((1,))))
+
+
+# ------------------------------------------------------- failure detection
+
+
+def _client():
+    import jax._src.distributed as _jdist
+    return getattr(_jdist.global_state, "client", None)
+
+
+_hb_started = False
+# reader-side observations: rank -> (last counter, local time first seen)
+_hb_seen = {}
+
+
+def heartbeat_start(period: float = 5.0) -> bool:
+    """Publish this worker's liveness to the coordinator's key-value store
+    every ``period`` seconds (reference: ps-lite worker heartbeats to the
+    scheduler, feeding kvstore.h:287 get_num_dead_node). The payload is a
+    monotonically increasing beat COUNTER, not a wall-clock timestamp —
+    staleness is judged on the reader's own clock, so cross-host clock
+    skew cannot fake deaths. Idempotent; returns False when no
+    coordination client exists (single process)."""
+    global _hb_started
+    import logging
+    import threading
+    import time
+    client = _client()
+    if client is None:
+        return False
+    if _hb_started:
+        return True
+    _hb_started = True
+
+    me = "mxnet_hb/%d" % rank()
+
+    def beat():
+        n = 0
+        warned = False
+        while True:
+            n += 1
+            try:
+                try:
+                    client.key_value_set(me, str(n), allow_overwrite=True)
+                except TypeError:   # older jaxlib: no overwrite kwarg
+                    try:
+                        client.key_value_delete(me)
+                    except Exception:
+                        pass
+                    client.key_value_set(me, str(n))
+            except Exception as exc:
+                # transient coordinator hiccups must not kill the beat —
+                # a dead thread would report this live worker dead forever
+                if not warned:
+                    logging.warning("heartbeat publish failed "
+                                    "(will keep retrying): %s", exc)
+                    warned = True
+            time.sleep(period)
+
+    t = threading.Thread(target=beat, daemon=True, name="mxnet-heartbeat")
+    t.start()
+    return True
+
+
+def num_dead_nodes(stale_after: float = 20.0, timeout_ms: int = 1000) -> int:
+    """Count workers whose heartbeat is missing, or whose beat counter has
+    not advanced for ``stale_after`` seconds of the CALLER's clock (two
+    observations are needed to declare staleness, so a first call never
+    false-positives on a slow-but-alive worker)."""
+    import time
+    client = _client()
+    if client is None:
+        return 0
+    dead = 0
+    now = time.monotonic()
+    for r in range(num_workers()):
+        try:
+            counter = int(client.blocking_key_value_get(
+                "mxnet_hb/%d" % r, timeout_ms))
+        except Exception:
+            dead += 1               # never heartbeated within the timeout
+            continue
+        prev = _hb_seen.get(r)
+        if prev is None or prev[0] != counter:
+            _hb_seen[r] = (counter, now)
+        elif now - prev[1] > stale_after:
+            dead += 1
+    return dead

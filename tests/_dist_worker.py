@@ -66,6 +66,12 @@ def main():
 
     params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
     np.savez(os.path.join(outdir, "params_rank%d.npz" % rank), **params)
+
+    # --- failure detection (§5.3): heartbeats published via the
+    # coordinator KV store; everyone alive -> zero dead nodes
+    dead = kv.get_num_dead_node(0, timeout=2)
+    assert dead == 0, "expected no dead nodes, got %d" % dead
+
     kv.barrier()
     print("dist worker rank %d/%d OK" % (rank, n), flush=True)
 
