@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import ast
 import json
+import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -300,26 +301,55 @@ class Symbol:
 
     # ------------------------------------------------------------ save/load
     def tojson(self) -> str:
-        """(reference: MXSymbolSaveToJSON, src/c_api/c_api_symbolic.cc —
-        nodes/arg_nodes/heads container)."""
-        nodes = _topo_order(self._entries)
+        """Serialize to the REFERENCE's symbol-JSON schema
+        (MXSymbolSaveToJSON -> nnvm saveload; the exact container the
+        reference's own checkpoints use and ``MXSymbolCreateFromJSON``
+        loads — see tests/python/unittest/save_000800.json): per node
+        ``op``/``param`` (op attrs, stringified)/``name``/``inputs``/
+        ``attr`` (user attrs), plus ``arg_nodes`` and ``heads``. Files
+        written here load in the reference and vice versa."""
+        # auto-created aux-state variables (BatchNorm moving stats) are NOT
+        # part of the reference's serialized graph — they are re-derived
+        # from op metadata on load. Trim them from op inputs, then drop
+        # only the aux nodes nothing references anymore (an aux variable
+        # used as a head — get_internals — or bound explicitly by the user
+        # stays serialized, like the reference's 1.x files).
+        topo = _topo_order(self._entries)
+        trimmed: Dict[int, list] = {}
+        for n in topo:
+            ins = list(n.inputs)
+            if not n.is_variable and n.op.num_aux:
+                k = n.op.num_aux
+                tail = ins[len(ins) - k:]
+                if len(tail) == k and all(
+                        src.is_variable and src.is_aux for src, _ in tail):
+                    ins = ins[:len(ins) - k]
+            trimmed[id(n)] = ins
+        referenced = {id(src) for n in topo for src, _ in trimmed[id(n)]}
+        referenced |= {id(n) for n, _ in self._entries}
+        nodes = [n for n in topo
+                 if not (n.is_variable and n.is_aux
+                         and id(n) not in referenced)]
         index = {id(n): i for i, n in enumerate(nodes)}
         out_nodes = []
         for n in nodes:
-            out_nodes.append({
+            ins = trimmed[id(n)]
+            entry = {
                 "op": "null" if n.is_variable else n.op.name,
+                "param": {} if n.is_variable else
+                         {k: _attr_str(v) for k, v in n.attrs.items()},
                 "name": n.name,
-                "attrs": {k: _attr_str(v) for k, v in n.attrs.items()},
-                "str_attrs": dict(n.str_attrs),
-                "is_aux": bool(n.is_aux),
-                "inputs": [[index[id(src)], i, 0] for src, i in n.inputs],
-            })
+                "inputs": [[index[id(src)], i] for src, i in ins],
+                "backward_source_id": -1,
+            }
+            if n.str_attrs:
+                entry["attr"] = dict(n.str_attrs)
+            out_nodes.append(entry)
         arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
-        heads = [[index[id(n)], i, 0] for n, i in self._entries]
+        heads = [[index[id(n)], i] for n, i in self._entries]
         return json.dumps({
-            "nodes": out_nodes, "arg_nodes": arg_nodes, "heads": heads,
-            "attrs": {"mxnet_version": ["int", 1100],
-                      "framework": "mxnet_tpu"}}, indent=2)
+            "nodes": out_nodes, "arg_nodes": arg_nodes,
+            "heads": heads}, indent=2)
 
     def save(self, fname: str) -> None:
         with open(fname, "w") as f:
@@ -531,23 +561,83 @@ def make_symbol_function(op: OpDef):
 
 
 def load_json(json_str: str) -> Symbol:
-    """(reference: symbol.py load_json)."""
+    """Load a reference-format symbol JSON (the schema of
+    ``MXSymbolCreateFromJSON``, src/c_api/c_api_symbolic.cc). Accepts
+    every vintage of the container: 0.8-era ``param``+``attr`` (see the
+    reference fixture tests/python/unittest/save_000800.json), 1.x-era
+    merged ``attrs``, and 2-element or 3-element input/head tuples.
+    Auxiliary states are re-derived from each op's aux arity, like the
+    reference re-derives them from op metadata on load."""
     g = json.loads(json_str)
-    raw_nodes = g["nodes"]
     built: List[_Node] = []
-    for rn in raw_nodes:
+    for rn in g["nodes"]:
         if rn["op"] == "null":
-            node = _Node(None, rn["name"], is_aux=bool(rn.get("is_aux")))
-            node.str_attrs = dict(rn.get("str_attrs", rn.get("attrs", {})))
+            node = _Node(None, rn["name"],
+                         is_aux=bool(rn.get("is_aux", False)))
+            node.str_attrs = {
+                k: str(v) for k, v in
+                (rn.get("attr") or rn.get("attrs") or
+                 rn.get("str_attrs") or {}).items()}
         else:
             op = get_op(rn["op"])
-            attrs = {k: _parse_attr(v) for k, v in rn.get("attrs", {}).items()}
-            inputs = [(built[i], j) for i, j, _ in rn["inputs"]]
+            if "param" in rn:              # 0.8 era: op attrs live here
+                op_attrs = rn["param"]
+                user_attrs = rn.get("attr", {})
+            else:                          # 1.x era: one merged dict
+                merged = dict(rn.get("attrs", {}))
+                user_keys = ("ctx_group", "lr_mult", "wd_mult",
+                             "__shape__", "__layout__", "__dtype__",
+                             "__init__", "force_mirroring")
+                user_attrs = {k: merged.pop(k) for k in list(merged)
+                              if k in user_keys or k.startswith("__")}
+                op_attrs = merged
+                user_attrs.update(rn.get("str_attrs", {}))
+            attrs = {k: _parse_attr(v) for k, v in op_attrs.items()}
+            attrs = _filter_op_attrs(op, attrs, rn["name"])
+            inputs = [(built[e[0]], e[1]) for e in rn["inputs"]]
+            n_aux = op.num_aux
+            if n_aux:
+                visible = len(op.input_names)
+                if attrs.get("no_bias") and "bias" in op.input_names:
+                    visible -= 1
+                if len(inputs) >= visible + n_aux:
+                    # file serialized the aux states as graph inputs
+                    # (reference 1.x style) — adopt them as aux
+                    for src, _ in inputs[-n_aux:]:
+                        if src.is_variable:
+                            src.is_aux = True
+                else:
+                    # 0.8-style file omits aux states — re-create them by
+                    # the <name>_<aux> convention (make_symbol_function)
+                    for aux_name in op.aux_input_names:
+                        v = _Node(None, "%s_%s" % (rn["name"], aux_name),
+                                  is_aux=True)
+                        inputs.append((v, 0))
             node = _Node(op, rn["name"], attrs, inputs)
-            node.str_attrs = dict(rn.get("str_attrs", {}))
+            node.str_attrs = {k: str(v) for k, v in user_attrs.items()}
         built.append(node)
-    entries = [(built[i], j) for i, j, _ in g["heads"]]
+    entries = [(built[e[0]], e[1]) for e in g["heads"]]
     return Symbol(entries)
+
+
+def _filter_op_attrs(op, attrs, node_name):
+    """Drop serialized op params this build doesn't take (workspace,
+    cudnn_tune, ... — backend tuning knobs of the reference with no TPU
+    meaning), so reference checkpoints load instead of erroring."""
+    import inspect
+    try:
+        params = inspect.signature(op.fn).parameters
+    except (TypeError, ValueError):
+        return attrs
+    if any(p.kind is p.VAR_KEYWORD for p in params.values()):
+        return attrs
+    known = set(params)
+    dropped = [k for k in attrs if k not in known]
+    if dropped:
+        logging.getLogger(__name__).debug(
+            "load_json: dropping unsupported attrs %s of node %r (%s)",
+            dropped, node_name, op.name)
+    return {k: v for k, v in attrs.items() if k in known}
 
 
 def load(fname: str) -> Symbol:

@@ -9,10 +9,14 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, *args, timeout=420):
+def _run(script, *args, timeout=560):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # share the suite's persistent compile cache (the config knob) so the
+    # subprocess doesn't recompile everything under load
+    env.setdefault("MXNET_COMPILATION_CACHE_DIR",
+                   os.path.join(ROOT, "tests", ".jax_cache"))
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", script)] + list(args),
         env=env, capture_output=True, text=True, timeout=timeout)

@@ -1,0 +1,179 @@
+"""Reference symbol-JSON interop (reference format:
+MXSymbolCreateFromJSON / MXSymbolSaveToJSON, src/c_api/c_api_symbolic.cc;
+oracle file: the reference's own checkpoint fixture
+tests/python/unittest/save_000800.json)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+# a verbatim 0.8-era reference-schema MLP (same structure as the reference's
+# save_000800.json fixture: param/attr split, 2-element inputs/heads)
+REFERENCE_MLP_JSON = json.dumps({
+    "nodes": [
+        {"op": "null", "param": {}, "name": "data", "inputs": [],
+         "backward_source_id": -1,
+         "attr": {"ctx_group": "stage1", "lr_mult": "0.2"}},
+        {"op": "null", "param": {}, "name": "fc1_weight", "inputs": [],
+         "backward_source_id": -1, "attr": {"wd_mult": "0.3"}},
+        {"op": "null", "param": {}, "name": "fc1_bias", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "FullyConnected",
+         "param": {"no_bias": "False", "num_hidden": "16",
+                   "workspace": "1024"},
+         "name": "fc1", "inputs": [[0, 0], [1, 0], [2, 0]],
+         "backward_source_id": -1},
+        {"op": "Activation", "param": {"act_type": "relu"}, "name": "relu1",
+         "inputs": [[3, 0]], "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "fc2_weight", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "fc2_bias", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "FullyConnected",
+         "param": {"no_bias": "False", "num_hidden": "4"},
+         "name": "fc2", "inputs": [[4, 0], [5, 0], [6, 0]],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "softmax_label", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "SoftmaxOutput", "param": {"grad_scale": "1"},
+         "name": "softmax", "inputs": [[7, 0], [8, 0]],
+         "backward_source_id": -1},
+    ],
+    "arg_nodes": [0, 1, 2, 5, 6, 8],
+    "heads": [[9, 0]],
+})
+
+
+def test_load_reference_schema_and_bind():
+    sym = mx.sym.load_json(REFERENCE_MLP_JSON)
+    assert sym.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias",
+                                    "softmax_label"]
+    # user attrs survive
+    assert sym.attr_dict()["data"]["ctx_group"] == "stage1"
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(8, 10), softmax_label=(8,))
+    out = ex.forward()[0]
+    assert out.shape == (8, 4)
+
+
+def test_reference_fixture_loads():
+    """The reference repo's own checkpoint fixture parses and binds."""
+    path = "/root/reference/tests/python/unittest/save_000800.json"
+    if not os.path.exists(path):
+        pytest.skip("reference fixture not available")
+    sym = mx.sym.load(path)
+    args = sym.list_arguments()
+    assert args[0] == "data" and "fc1_weight" in args
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(4, 32), softmax_label=(4,))
+    assert ex.forward()[0].shape[0] == 4
+
+
+def test_roundtrip_preserves_semantics():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    js = net.tojson()
+    # exported container is the reference schema
+    g = json.loads(js)
+    assert set(g) == {"nodes", "arg_nodes", "heads"}
+    assert all("param" in n for n in g["nodes"])
+    assert all(len(e) == 2 for n in g["nodes"] for e in n["inputs"])
+
+    back = mx.sym.load_json(js)
+    assert back.list_arguments() == net.list_arguments()
+    rng = np.random.RandomState(0)
+    vals = {}
+    for name, shp in zip(net.list_arguments(),
+                         net.infer_shape(data=(4, 6),
+                                         softmax_label=(4,))[0]):
+        vals[name] = mx.nd.array(rng.rand(*shp).astype(np.float32))
+    o1 = net.eval(**vals)[0].asnumpy()
+    o2 = back.eval(**vals)[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_roundtrip_batchnorm_aux_rederived():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    js = net.tojson()
+    g = json.loads(js)
+    bn = [n for n in g["nodes"] if n["op"] == "BatchNorm"][0]
+    assert len(bn["inputs"]) == 3          # data, gamma, beta — no aux
+    back = mx.sym.load_json(js)
+    assert back.list_auxiliary_states() == net.list_auxiliary_states()
+    ex = back.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8))
+    assert ex.forward()[0].shape == (2, 4, 6, 6)
+
+
+def test_load_1x_style_batchnorm_with_serialized_aux():
+    """Reference 1.x files serialize moving stats as graph nodes — they
+    must be adopted as aux, not duplicated (regression)."""
+    js = json.dumps({
+        "nodes": [
+            {"op": "null", "param": {}, "name": "data", "inputs": []},
+            {"op": "null", "param": {}, "name": "bn_gamma", "inputs": []},
+            {"op": "null", "param": {}, "name": "bn_beta", "inputs": []},
+            {"op": "null", "param": {}, "name": "bn_moving_mean",
+             "inputs": []},
+            {"op": "null", "param": {}, "name": "bn_moving_var",
+             "inputs": []},
+            {"op": "BatchNorm", "param": {"eps": "0.001"}, "name": "bn",
+             "inputs": [[0, 0], [1, 0], [2, 0], [3, 0], [4, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2, 3, 4],
+        "heads": [[5, 0]],
+    })
+    sym = mx.sym.load_json(js)
+    assert sym.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert sym.list_auxiliary_states() == ["bn_moving_mean",
+                                           "bn_moving_var"]
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(2, 3, 4, 4))
+    assert ex.forward()[0].shape == (2, 3, 4, 4)
+
+
+def test_get_internals_with_aux_head_serializes():
+    """get_internals() exposes aux variables as heads; tojson must not
+    KeyError on them (regression)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data, name="bn")
+    internals = net.get_internals()
+    js = internals.tojson()
+    back = mx.sym.load_json(js)
+    assert "bn_moving_mean" in (back.list_auxiliary_states()
+                                + back.list_arguments() + back.list_outputs())
+
+
+def test_explicit_aux_binding_survives_roundtrip():
+    """A user-bound aux symbol must keep its edge through save/load
+    (regression: it was silently dropped and re-created under a new
+    name)."""
+    data = mx.sym.Variable("data")
+    custom = mx.sym.Variable("custom_mean")
+    net = mx.sym.BatchNorm(data, moving_mean=custom, name="bn")
+    back = mx.sym.load_json(net.tojson())
+    assert "custom_mean" in back.list_auxiliary_states()
+
+
+def test_module_checkpoint_roundtrips_through_reference_schema(tmp_path):
+    """save_checkpoint -> load_checkpoint through the new format."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))], label_shapes=[("softmax_label",
+                                                            (4,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    assert sym2.list_arguments() == net.list_arguments()
+    np.testing.assert_allclose(
+        arg2["fc_weight"].asnumpy(),
+        mod.get_params()[0]["fc_weight"].asnumpy())
