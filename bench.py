@@ -68,14 +68,24 @@ def main():
     dbatch = mx.io.DataBatch(data=[mx.nd.array(x, ctx=ctx)],
                              label=[mx.nd.array(y, ctx=ctx)])
 
+    def drain():
+        # On the experimental remote-TPU plugin this machine uses,
+        # block_until_ready returns before execution finishes — measured:
+        # fencing with block_until_ready alone reported 147k img/s
+        # (18x the chip's physical bf16 peak, impossible), while this
+        # host read reports 2.2k img/s. Standard backends don't need
+        # this; keep the host read as the fence wherever this bench runs.
+        return float(np.asarray(
+            mod._exec.arg_dict["fc1_weight"].data[0, 0]))
+
     for _ in range(WARMUP):
         mod._fit_step(dbatch)
-    jax.block_until_ready(mod._exec.arg_dict["fc1_weight"].data)
+    drain()
 
     t0 = time.perf_counter()
     for _ in range(iters):
         mod._fit_step(dbatch)
-    jax.block_until_ready(mod._exec.arg_dict["fc1_weight"].data)
+    drain()
     dt = time.perf_counter() - t0
 
     img_s = batch * iters / dt
