@@ -132,6 +132,9 @@ def _apply_import_knobs() -> None:
         import jax
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # HLO only: the AOT kernel cache embeds exact host CPU features
+        # and spews loader errors when they drift
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
     if get("MXNET_PROFILER_AUTOSTART"):
         from . import profiler
         profiler.set_state("run")

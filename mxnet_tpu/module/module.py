@@ -41,13 +41,25 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, mesh_shape=None, param_shardings=None):
+        """``mesh_shape``/``param_shardings`` are the tensor-parallel
+        surface (SURVEY §2.21): ``mesh_shape={"data": 2, "model": 4}``
+        lays the context list out as a 2D mesh, and ``param_shardings``
+        maps parameter names (exact or regex) to ``parallel.P`` partition
+        specs over those axes — e.g. ``{"fc1_weight": P("model", None)}``
+        column-shards fc1. The batch stays sharded over ``data``; XLA
+        partitions the matmuls and inserts the tensor-parallel collectives
+        from the operand shardings (GSPMD), so the same fused train step
+        serves dp, tp, and dp x tp without code changes."""
         super().__init__(logger=logger)
         if context is None:
             context = cpu()
         if isinstance(context, Context):
             context = [context]
         self._context: List[Context] = list(context)
+        self._mesh_shape = dict(mesh_shape) if mesh_shape else None
+        self._param_shardings = dict(param_shardings) \
+            if param_shardings else None
         # work_load_list existed to weight uneven GPUs
         # (executor_group.py:99); a TPU mesh is homogeneous, accepted and
         # ignored for API compatibility.
@@ -201,15 +213,32 @@ class Module(BaseModule):
         if self._mesh is not None:
             self._replicate_params()
 
-    def _replicate_params(self):
-        """Replicate parameters over the data-parallel mesh so one jitted
-        program serves all devices (replaces per-device param copies in
-        executor_group.py + kvstore broadcast)."""
+    def _sharding_for(self, name):
+        """Resolve a parameter's NamedSharding: an exact or regex match in
+        param_shardings wins (tensor parallel), else replicated (data
+        parallel)."""
+        from jax.sharding import NamedSharding
         from ..parallel.mesh import replicated_sharding
-        sh = replicated_sharding(self._mesh)
+        if self._param_shardings:
+            import re
+            spec = self._param_shardings.get(name)
+            if spec is None:
+                for pat, s in self._param_shardings.items():
+                    if re.fullmatch(pat, name):
+                        spec = s
+                        break
+            if spec is not None:
+                return NamedSharding(self._mesh, spec)
+        return replicated_sharding(self._mesh)
+
+    def _replicate_params(self):
+        """Place parameters on the mesh: replicated over ``data``, and
+        partitioned per param_shardings over ``model`` (replaces per-device
+        param copies in executor_group.py + kvstore broadcast)."""
         for d in (self._exec.arg_dict, self._exec.aux_dict):
             for name, arr in d.items():
-                arr._data = jax.device_put(arr._data, sh)
+                arr._data = jax.device_put(arr._data,
+                                           self._sharding_for(name))
 
     # ------------------------------------------------------------- binding
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -236,7 +265,22 @@ class Module(BaseModule):
         shape_hints.update({d.name: d.shape for d in self._label_shapes
                             if d.name in self._symbol.list_arguments()})
 
-        if len(self._context) > 1:
+        if self._mesh_shape is not None:
+            from ..parallel.mesh import make_mesh
+            if len(self._context) > 1:
+                want = int(np.prod([s for s in self._mesh_shape.values()
+                                    if s != -1]))
+                if -1 not in self._mesh_shape.values() \
+                        and want != len(self._context):
+                    raise ValueError(
+                        "mesh_shape %r uses %d devices but %d contexts "
+                        "were given — they must match (use -1 to absorb "
+                        "the rest)" % (self._mesh_shape, want,
+                                       len(self._context)))
+            self._mesh = make_mesh(self._mesh_shape,
+                                   contexts=self._context
+                                   if len(self._context) > 1 else None)
+        elif len(self._context) > 1:
             from ..parallel.mesh import data_parallel_mesh
             self._mesh = data_parallel_mesh(self._context)
         else:
@@ -458,7 +502,16 @@ class Module(BaseModule):
                 new_states[n] = s
             return outs, new_params, new_states, new_aux
 
-        self._fused_jit = jax.jit(step, donate_argnums=(0, 1, 2))
+        if self._mesh is not None:
+            # pin updated params to their declared shardings — otherwise
+            # GSPMD may pick a different output layout after the first
+            # step and the user-declared tp partitioning drifts
+            param_sh = {n: self._sharding_for(n) for n in param_names}
+            self._fused_jit = jax.jit(
+                step, donate_argnums=(0, 1, 2),
+                out_shardings=(None, param_sh, None, None))
+        else:
+            self._fused_jit = jax.jit(step, donate_argnums=(0, 1, 2))
         self._fused_num_update = self._optimizer.num_update
 
         def run(data_batch):
@@ -527,8 +580,13 @@ class Module(BaseModule):
             if val.dtype != tgt.data.dtype:
                 val = val.astype(tgt.data.dtype)
             if self._mesh is not None:
-                from ..parallel.mesh import shard_batch
-                val = shard_batch(self._mesh, val)
+                if "data" in self._mesh.axis_names:
+                    from ..parallel.mesh import shard_batch
+                    val = shard_batch(self._mesh, val)
+                else:
+                    # pure tensor-parallel mesh: the batch is replicated
+                    from ..parallel.mesh import replicate
+                    val = replicate(self._mesh, val)
             else:
                 val = jax.device_put(val, self._context[0].jax_device)
             tgt._data = val
