@@ -82,6 +82,34 @@ class ImageRecordIter(DataIter):
         self._aug_list = aug_list      # mx.image Augmenter pipeline override
         self._path = path_imgrec
 
+        from .. import config as _config
+        if preprocess_threads is None:
+            preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS")
+        if prefetch_buffer is None:
+            prefetch_buffer = _config.get("MXNET_PREFETCH_BUFFER")
+        self._n_threads = max(1, int(preprocess_threads))
+        self._prefetch = max(2, int(prefetch_buffer))
+        self._shuffle = shuffle
+        self._round_batch = bool(round_batch)
+
+        # Native C++ pipeline (mxnet_tpu/native: RecordIO mmap reader +
+        # libjpeg/libpng decode + threaded augment/batch workers) handles
+        # the standard crop/mirror/mean-std path entirely off the Python
+        # thread; custom Augmenter pipelines and mean_img files fall back
+        # to the Python/cv2 path below.
+        self._native = None
+        if (aug_list is None and self._params.get("mean_arr") is None
+                and max_random_scale == 1.0 and min_random_scale == 1.0
+                and self.data_shape[0] in (1, 3)):
+            self._native = _NativePipe(self, seed)
+            if self._native.handle is None:
+                self._native = None
+        if self._native is not None:
+            self._order = np.arange(self._native.count)
+            self._native.start_epoch(self._epoch_order())
+            return
+
+        # ---- pure-Python fallback path ----
         # index the record offsets once so shuffle is a permutation of offsets
         self._offsets: List[int] = []
         rec = MXRecordIO(path_imgrec, "r")
@@ -93,15 +121,6 @@ class ImageRecordIter(DataIter):
             self._offsets.append(pos)
         rec.close()
         self._order = np.arange(len(self._offsets))
-        self._shuffle = shuffle
-
-        from .. import config as _config
-        if preprocess_threads is None:
-            preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS")
-        if prefetch_buffer is None:
-            prefetch_buffer = _config.get("MXNET_PREFETCH_BUFFER")
-        self._n_threads = max(1, int(preprocess_threads))
-        self._prefetch = max(2, int(prefetch_buffer))
         self._epoch_queue: "queue.Queue" = queue.Queue()
         self._batch_queue: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
         self._lock = threading.Lock()
@@ -111,6 +130,17 @@ class ImageRecordIter(DataIter):
         self._reset_evt = threading.Event()
         self._reset_evt.set()
         self._loader.start()
+
+    def _epoch_order(self):
+        order = self._order.copy()
+        if self._shuffle:
+            self._rng.shuffle(order)
+        return order
+
+    @property
+    def num_data(self) -> int:
+        """Number of records in the dataset (both pipeline backends)."""
+        return len(self._order)
 
     # ------------------------------------------------------------ pipeline
     def _decode_and_augment(self, buf: bytes):
@@ -199,9 +229,7 @@ class ImageRecordIter(DataIter):
                     self._batch_queue.put(("error", exc, None, 0))
 
     def _produce_epoch(self, pool):
-        order = self._order.copy()
-        if self._shuffle:
-            self._rng.shuffle(order)
+        order = self._epoch_order()
         rec = MXRecordIO(self._path, "r")
         bufs = []
         # stream sequentially; shuffled access uses offsets
@@ -220,7 +248,7 @@ class ImageRecordIter(DataIter):
                                        np.asarray(labels, np.float32), 0))
                 bufs = []
         rec.close()
-        if bufs and self._alive:
+        if bufs and self._alive and self._round_batch:
             pad = self.batch_size - len(bufs)
             futures = [pool.submit(self._decode_and_augment, x)
                        for x in bufs]
@@ -245,6 +273,9 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape, np.float32)]
 
     def reset(self):
+        if self._native is not None:
+            self._native.start_epoch(self._epoch_order())
+            return
         while True:
             try:
                 self._batch_queue.get_nowait()
@@ -253,6 +284,16 @@ class ImageRecordIter(DataIter):
         self._reset_evt.set()
 
     def next(self):
+        if self._native is not None:
+            imgs, labels, pad = self._native.next()   # raises StopIteration
+            if self.label_width == 1:
+                labels = labels[:, 0]
+            return DataBatch(data=[nd.array(imgs.astype(self._dtype,
+                                                        copy=False),
+                                            dtype=self._dtype)],
+                             label=[nd.array(labels)], pad=pad,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
         kind, imgs, labels, pad = self._batch_queue.get()
         if kind == "error":
             raise imgs                # exception from the loader thread
@@ -272,12 +313,89 @@ class ImageRecordIter(DataIter):
             return False
 
     def __del__(self):
+        if getattr(self, "_native", None) is not None:
+            self._native.close()
+            return
+        if not hasattr(self, "_reset_evt"):
+            return
         self._alive = False
         self._reset_evt.set()
         try:
             self._batch_queue.get_nowait()
         except Exception:
             pass
+
+
+class _NativePipe:
+    """ctypes wrapper around the libmxnative batch pipeline (one instance
+    per ImageRecordIter; owns the reader + pipeline handles)."""
+
+    def __init__(self, it: "ImageRecordIter", seed: int):
+        import ctypes
+        from .. import native
+        self.handle = None
+        self._rec = None
+        lib = native.lib()
+        if lib is None:
+            return
+        rec = lib.mxrio_open(it._path.encode())
+        if not rec:
+            return
+        self._lib = lib
+        self._ct = ctypes
+        self._rec = rec
+        self.count = lib.mxrio_count(rec)
+        p = it._params
+        c, h, w = it.data_shape
+        cfg = native.MXPipeConfig()
+        cfg.batch_size = it.batch_size
+        cfg.target_h, cfg.target_w, cfg.target_c = h, w, c
+        cfg.label_width = it.label_width
+        cfg.resize = int(p["resize"])
+        cfg.rand_crop = int(bool(p["rand_crop"]))
+        cfg.rand_mirror = int(bool(p["rand_mirror"]))
+        cfg.mean[:] = [float(x) for x in p["mean"]]
+        cfg.std_[:] = [float(x) for x in p["std"]]
+        cfg.scale = float(p["scale"])
+        cfg.seed = seed
+        cfg.num_threads = it._n_threads
+        cfg.queue_depth = it._prefetch
+        cfg.round_batch = int(it._round_batch)
+        self._shape = (it.batch_size, c, h, w)
+        self._label_shape = (it.batch_size, it.label_width)
+        self.handle = lib.mxpipe_create(rec, ctypes.byref(cfg))
+
+    def start_epoch(self, order):
+        import numpy as _np
+        ct = self._ct
+        order = _np.ascontiguousarray(order, dtype=_np.int64)
+        self._lib.mxpipe_start_epoch(
+            self.handle, order.ctypes.data_as(ct.POINTER(ct.c_int64)),
+            len(order))
+
+    def next(self):
+        import numpy as _np
+        ct = self._ct
+        data = _np.empty(self._shape, _np.float32)
+        label = _np.empty(self._label_shape, _np.float32)
+        pad = ct.c_int()
+        rc = self._lib.mxpipe_next(
+            self.handle, data.ctypes.data_as(ct.POINTER(ct.c_float)),
+            label.ctypes.data_as(ct.POINTER(ct.c_float)), ct.byref(pad))
+        if rc == 1:
+            raise StopIteration
+        if rc != 0:
+            raise IOError("native pipeline: %s"
+                          % self._lib.mxpipe_error(self.handle).decode())
+        return data, label, pad.value
+
+    def close(self):
+        if self.handle:
+            self._lib.mxpipe_close(self.handle)
+            self.handle = None
+        if self._rec:
+            self._lib.mxrio_close(self._rec)
+            self._rec = None
 
 
 class ImageRecordUInt8Iter(ImageRecordIter):
