@@ -6,6 +6,8 @@ The TPU-native replacement for the reference's distribution stack
 * data parallel  → batch sharded over a ``data`` mesh axis (mesh.py)
 * tensor parallel → parameters sharded over a ``model`` axis (GSPMD)
 * model parallel (group2ctx) → per-arg device shardings (executor.py)
+* pipeline parallel → GPipe microbatch schedule over a mesh axis (pipeline.py)
+* expert parallel → MoE with all_to_all token dispatch (moe.py)
 * sequence parallel / long context → ring attention (ring_attention.py)
 * multi-host → ``jax.distributed`` + the same mesh spanning hosts
 """
@@ -14,8 +16,11 @@ from .mesh import (make_mesh, data_parallel_mesh, batch_sharding,
                    NamedSharding, mesh_devices)
 from .ring_attention import (ring_attention, ring_self_attention,
                              local_attention_block)
+from .pipeline import pipeline_apply, stack_stage_params
+from .moe import moe_init, moe_apply
 
 __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "replicated_sharding", "shard_batch", "replicate", "P", "Mesh",
            "NamedSharding", "mesh_devices", "ring_attention",
-           "ring_self_attention", "local_attention_block"]
+           "ring_self_attention", "local_attention_block",
+           "pipeline_apply", "stack_stage_params", "moe_init", "moe_apply"]

@@ -1,0 +1,94 @@
+"""Expert parallelism: mixture-of-experts FFN over an ``expert`` mesh axis.
+
+The reference has no MoE (SURVEY.md §2.21 marks expert parallel absent);
+this is the modern capability the TPU build adds on top of parity. The
+design is the TPU-idiomatic dense-dispatch form (Switch Transformer /
+GShard): routing builds dispatch/combine tensors, expert inputs are
+gathered with an einsum, and ``with_sharding_constraint`` pins the expert
+dimension to the ``expert`` mesh axis — XLA/GSPMD then lowers the two
+dispatch einsums to ``all_to_all`` collectives over ICI. No hand-written
+comms; everything stays differentiable and jit-compatible.
+"""
+from __future__ import annotations
+
+__all__ = ["moe_init", "moe_apply"]
+
+
+def moe_init(rng, d_model: int, d_hidden: int, n_experts: int, dtype=None):
+    """Initialize router + expert FFN parameters.
+
+    Returns {"router": (d, E), "wi": (E, d, h), "wo": (E, h, d)}.
+    """
+    import numpy as np
+    dtype = dtype or np.float32
+    s_in = 1.0 / np.sqrt(d_model)
+    s_hid = 1.0 / np.sqrt(d_hidden)
+    return {
+        "router": (rng.normal(0, s_in, (d_model, n_experts))).astype(dtype),
+        "wi": (rng.normal(0, s_in, (n_experts, d_model, d_hidden))
+               ).astype(dtype),
+        "wo": (rng.normal(0, s_hid, (n_experts, d_hidden, d_model))
+               ).astype(dtype),
+    }
+
+
+def moe_apply(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
+              mesh=None, axis: str = "expert"):
+    """Apply the MoE FFN to tokens ``x`` of shape (tokens, d_model).
+
+    Routing is top-``top_k`` softmax gating with per-expert capacity
+    ``C = ceil(tokens * top_k * capacity_factor / E)``; tokens over
+    capacity at an expert are dropped for that expert (standard Switch
+    semantics — gate mass is renormalized over surviving assignments).
+
+    Under ``jit`` with ``mesh``, the expert dimension of the dispatched
+    activations is sharded over ``axis`` so each device runs only its
+    experts; the surrounding einsums become all_to_all + local matmul.
+    Returns (tokens, d_model) combined outputs plus the load-balancing
+    auxiliary loss (GShard aux: E * sum_e f_e * p_e).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    T, D = x.shape
+    E = params["router"].shape[1]
+    k = min(top_k, E)
+    C = max(1, int(-(-T * k * capacity_factor // E)))  # ceil
+
+    logits = x @ params["router"]                      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)      # (T, k)
+
+    # position of each (token, choice) in its expert's capacity buffer:
+    # count prior assignments to the same expert in (token, choice) order
+    choice_mask = jax.nn.one_hot(gate_idx, E, dtype=x.dtype)  # (T, k, E)
+    flat = choice_mask.reshape(T * k, E)
+    pos = jnp.cumsum(flat, axis=0) - flat              # (T*k, E)
+    pos = jnp.sum(pos * flat, axis=-1).reshape(T, k)   # (T, k)
+    keep = (pos < C).astype(x.dtype)
+    gate_vals = gate_vals * keep
+    denom = jnp.sum(gate_vals, axis=-1, keepdims=True)
+    gate_vals = gate_vals / jnp.maximum(denom, 1e-9)
+
+    pos_oh = jax.nn.one_hot(pos, C, dtype=x.dtype)     # (T, k, C)
+    # (T, E, C) combine weights; dispatch is its 0/1 support
+    combine = jnp.einsum("tke,tk,tkc->tec", choice_mask, gate_vals, pos_oh)
+    dispatch = jnp.einsum("tke,tk,tkc->tec", choice_mask, keep, pos_oh)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # (E, C, D)
+    if mesh is not None:
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, jax.sharding.NamedSharding(mesh, P(axis, None, None)))
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, params["wi"]))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, params["wo"])
+    if mesh is not None:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, jax.sharding.NamedSharding(mesh, P(axis, None, None)))
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # GShard load-balance aux loss: fraction routed vs mean gate prob
+    frac = jnp.mean(choice_mask[:, 0, :], axis=0)      # top-1 routing share
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return out, aux

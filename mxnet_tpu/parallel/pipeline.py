@@ -1,0 +1,107 @@
+"""Pipeline parallelism: GPipe-style microbatched schedule over a mesh axis.
+
+TPU-native upgrade of the reference's inter-layer model parallelism
+(``group2ctx`` + PlaceDevice inserting _CrossDeviceCopy nodes,
+src/executor/graph_executor.cc:279-393, demo example/model-parallel-lstm/
+lstm.py:65-129). The reference overlaps stages only through its dependency
+engine; here the schedule is explicit SPMD: every device runs the same
+program under ``shard_map``, holds one stage's parameters (stacked pytree
+sharded over the ``pipe`` axis), and microbatch activations hop stages via
+``lax.ppermute`` over ICI. ``M`` microbatches over ``N`` stages take
+``M + N - 1`` ticks (the GPipe bubble); everything is a ``lax.scan`` so XLA
+sees one compiled loop, and the whole thing is differentiable (``ppermute``
+has a transpose rule) so ``jax.grad`` of a pipelined loss just works.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage parameter pytrees along a new leading axis.
+
+    The result is what ``pipeline_apply`` expects: each leaf has shape
+    ``(n_stages, ...)``; shard the leading axis over the pipe mesh axis.
+    """
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe"):
+    """Run ``N = mesh.shape[axis]`` pipeline stages over microbatched input.
+
+    Parameters
+    ----------
+    stage_fn : callable(params_i, x) -> y
+        The per-stage computation; ``y`` must have ``x``'s shape/dtype
+        (residual-block style), so activations can hop devices uniformly.
+    stage_params : pytree
+        Per-stage parameters stacked on a leading ``n_stages`` axis
+        (see ``stack_stage_params``).
+    inputs : array (M, mb, ...)
+        ``M`` microbatches. ``M >= N`` keeps the bubble fraction at
+        ``(N-1)/(M+N-1)``.
+    mesh : jax.sharding.Mesh with the ``axis`` dimension.
+
+    Returns the (M, mb, ...) outputs of the last stage.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    n_stages = mesh.shape[axis]
+    n_micro = inputs.shape[0]
+    if n_micro < 1:
+        raise ValueError("need at least one microbatch")
+
+    # params: leading stage axis sharded over the pipe axis; inputs and
+    # outputs replicated (only stage 0 reads, only stage N-1 writes —
+    # jnp.where keeps the SPMD program uniform).
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+
+    def spmd(params, xs):
+        idx = lax.axis_index(axis)
+        # this device's stage params: shard_map hands us a leading axis of
+        # size n_stages/n_stages == 1
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        mb_shape = xs.shape[1:]
+        ticks = n_micro + n_stages - 1
+
+        def step(carry, t):
+            recv, outs = carry
+            x = jnp.where(idx == 0,
+                          xs[jnp.clip(t, 0, n_micro - 1)], recv)
+            y = stage_fn(local, x)
+            # device i hands its activation to i+1 (the last stage's
+            # output stays home and is collected below)
+            send = lax.ppermute(
+                y, axis, perm=[(i, i + 1) for i in range(n_stages - 1)])
+            out_t = t - (n_stages - 1)
+            take = jnp.logical_and(idx == n_stages - 1,
+                                   jnp.logical_and(out_t >= 0,
+                                                   out_t < n_micro))
+            outs = lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(take, y, lax.dynamic_index_in_dim(
+                    outs, jnp.clip(out_t, 0, n_micro - 1), 0,
+                    keepdims=False)),
+                jnp.clip(out_t, 0, n_micro - 1), 0)
+            return (send, outs), None
+
+        init = (jnp.zeros(mb_shape, inputs.dtype),
+                jnp.zeros((n_micro,) + mb_shape, inputs.dtype))
+        (_, outs), _ = lax.scan(step, init, jnp.arange(ticks))
+        # everyone returns; only the last stage's buffer is real —
+        # psum after masking replicates it across the pipe axis
+        outs = jnp.where(idx == n_stages - 1, outs, 0)
+        return lax.psum(outs, axis)
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(param_spec, P()),
+                   out_specs=P(), check_rep=False)
+    return fn(stage_params, inputs)
