@@ -51,6 +51,8 @@ from . import kvstore as kv
 from . import parallel
 from . import module
 from . import module as mod
+from . import predictor
+from .predictor import Predictor
 from . import gluon
 from . import models
 from . import rnn

@@ -1,0 +1,164 @@
+"""``mx.predictor`` — the minimal deployment / inference API.
+
+Reference: the C predict API (``include/mxnet/c_predict_api.h:77-178``,
+impl ``src/c_api/c_predict_api.cc``): load a symbol JSON + param blob,
+bind a forward-only executor, then ``SetInput -> Forward -> GetOutput``.
+The amalgamation builds ship only this path (SURVEY.md §2.19).
+
+TPU-native form: the "minimal runtime" is one jitted XLA program with
+frozen weights — ``Predictor`` binds a forward-only Executor (no gradient
+graph), device-puts the params once, and every ``forward`` is a single
+cached-compile call. ``reshape`` rebinds for a new input geometry the way
+``MXPredReshape`` does.
+"""
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import ndarray as nd
+from .context import Context, cpu, current_context
+from .symbol import load_json
+
+__all__ = ["Predictor"]
+
+
+class Predictor(object):
+    """Forward-only model runner (reference: MXPredCreate semantics).
+
+    Parameters
+    ----------
+    symbol_json : str
+        Symbol JSON string (or a path ending in ``.json``).
+    params : dict | str | bytes
+        ``{name: array}`` dict, or a path / byte blob in the ``nd.save``
+        container format with ``arg:``/``aux:`` prefixed keys (the
+        checkpoint format ``model.save_checkpoint`` writes).
+    input_shapes : dict | list of (name, shape)
+        Shapes of every input that is not a parameter.
+    ctx : Context, optional
+    """
+
+    def __init__(self, symbol_json, params, input_shapes,
+                 ctx: Optional[Context] = None):
+        self._ctx = ctx or current_context()
+        if isinstance(symbol_json, str) and symbol_json.endswith(".json"):
+            with open(symbol_json) as f:
+                symbol_json = f.read()
+        self._symbol = load_json(symbol_json)
+        self._arg_params, self._aux_params = self._load_params(params)
+        self._input_shapes = dict(input_shapes)
+        self._inputs: Dict[str, nd.NDArray] = {}
+        self._bind()
+
+    @staticmethod
+    def _load_params(params):
+        """Split a params source into (arg_params, aux_params)
+        (reference: c_predict_api.cc param-blob parsing of arg:/aux:
+        prefixed names)."""
+        if isinstance(params, (bytes, bytearray)):
+            import tempfile
+            with tempfile.NamedTemporaryFile(suffix=".params") as f:
+                f.write(params)
+                f.flush()
+                loaded = nd.load(f.name)
+        elif isinstance(params, str):
+            loaded = nd.load(params)
+        else:
+            loaded = {k: (v if isinstance(v, nd.NDArray) else nd.array(v))
+                      for k, v in params.items()}
+        arg_params, aux_params = {}, {}
+        for k, v in loaded.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        return arg_params, aux_params
+
+    def _bind(self):
+        sym = self._symbol
+        # args that are neither params nor declared inputs are zero-filled
+        # if their shape infers (checkpoints keep the loss head, so e.g.
+        # softmax_label rides along; forward ignores it — same situation
+        # the reference predict API handles for deployed training symbols)
+        missing = [n for n in sym.list_arguments()
+                   if n not in self._arg_params
+                   and n not in self._input_shapes]
+        hard = [n for n in missing if not n.endswith("label")]
+        if hard:
+            raise ValueError(
+                "Predictor: arguments %s are neither params nor declared "
+                "inputs" % hard)
+        shapes = dict(self._input_shapes)
+        shapes.update({k: v.shape for k, v in self._arg_params.items()
+                       if k in sym.list_arguments()})
+        try:
+            arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+        except Exception as exc:
+            raise ValueError(
+                "Predictor: cannot infer shapes%s: %s"
+                % (" (arguments %s are neither params nor declared inputs)"
+                   % missing if missing else "", exc)) from None
+        args = {}
+        for name, shp in zip(sym.list_arguments(), arg_shapes):
+            if name in self._arg_params:
+                args[name] = self._arg_params[name].copyto(self._ctx)
+            else:
+                args[name] = nd.zeros(shp, ctx=self._ctx)
+        aux = {}
+        for name, shp in zip(sym.list_auxiliary_states(), aux_shapes):
+            aux[name] = (self._aux_params[name].copyto(self._ctx)
+                         if name in self._aux_params
+                         else nd.zeros(shp, ctx=self._ctx))
+        self._exec = sym.bind(self._ctx, args=args, aux_states=aux,
+                              grad_req="null")
+
+    # ------------------------------------------------------------ predict
+    def set_input(self, name: str, value) -> "Predictor":
+        """(reference: MXPredSetInput)."""
+        if name not in self._input_shapes:
+            raise KeyError("unknown input %r (declared: %s)"
+                           % (name, sorted(self._input_shapes)))
+        arr = value if isinstance(value, nd.NDArray) else nd.array(value)
+        want = tuple(self._input_shapes[name])
+        if tuple(arr.shape) != want:
+            raise ValueError("input %r has shape %s, predictor bound for %s"
+                             " (use reshape())" % (name, arr.shape, want))
+        self._exec.arg_dict[name][:] = arr
+        return self
+
+    def forward(self, **inputs) -> List[nd.NDArray]:
+        """Run the forward program; keyword inputs are a shorthand for
+        set_input (reference: MXPredForward)."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        return self._exec.forward(is_train=False)
+
+    def get_output(self, index: int) -> nd.NDArray:
+        """(reference: MXPredGetOutput)."""
+        return self._exec.outputs[index]
+
+    @property
+    def outputs(self) -> List[nd.NDArray]:
+        return self._exec.outputs
+
+    def reshape(self, input_shapes) -> "Predictor":
+        """Rebind for new input geometry (reference: MXPredReshape)."""
+        self._input_shapes = dict(input_shapes)
+        self._bind()
+        return self
+
+    # ------------------------------------------------------------ loaders
+    @classmethod
+    def from_checkpoint(cls, prefix: str, epoch: int, input_shapes,
+                        ctx: Optional[Context] = None) -> "Predictor":
+        """Load ``prefix-symbol.json`` + ``prefix-%04d.params`` (the
+        Module/model checkpoint layout, reference model.py:370)."""
+        with open("%s-symbol.json" % prefix) as f:
+            sym_json = f.read()
+        return cls(sym_json, "%s-%04d.params" % (prefix, epoch),
+                   input_shapes, ctx=ctx)

@@ -51,3 +51,12 @@ def test_lstm_bucketing_cli():
 def test_model_parallel_lstm_cli():
     out = _run("model_parallel_lstm.py")
     assert "ok: nll" in out
+
+
+@pytest.mark.slow
+def test_train_ssd_cli():
+    """SSD detection convergence gate (SURVEY §2.15 example/ssd parity):
+    multi-scale heads + MultiBox ops must learn to localize."""
+    out = _run("train_ssd.py", "--num-epochs", "35",
+               "--num-examples", "256", "--batch-size", "32")
+    assert "mean IoU" in out

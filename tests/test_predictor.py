@@ -1,0 +1,79 @@
+"""Predictor — the predict-only deployment path (reference:
+include/mxnet/c_predict_api.h MXPredCreate/SetInput/Forward/GetOutput,
+SURVEY.md §2.19): a trained checkpoint must round-trip through the
+minimal forward-only runtime and reproduce Module.predict outputs.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _train_small(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 1, 8, 8).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > x.mean()).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="cv")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 2)
+    it.reset()
+    ref = mod.predict(it).asnumpy()
+    return prefix, x, ref
+
+
+def test_predictor_from_checkpoint_matches_module(tmp_path):
+    prefix, x, ref = _train_small(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 2, input_shapes={"data": (16, 1, 8, 8)}, ctx=mx.cpu())
+    outs = []
+    for s in range(0, 64, 16):
+        pred.forward(data=x[s:s + 16])
+        outs.append(pred.get_output(0).asnumpy())
+    np.testing.assert_allclose(np.concatenate(outs), ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_reshape_and_validation(tmp_path):
+    prefix, x, ref = _train_small(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 2, input_shapes={"data": (16, 1, 8, 8)}, ctx=mx.cpu())
+    with pytest.raises(ValueError):
+        pred.set_input("data", x[:4])          # wrong batch for the bind
+    with pytest.raises(KeyError):
+        pred.set_input("nope", x[:16])
+    pred.reshape({"data": (4, 1, 8, 8)})       # MXPredReshape parity
+    pred.forward(data=x[:4])
+    np.testing.assert_allclose(pred.get_output(0).asnumpy(), ref[:4],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_from_param_dict_and_json_string(tmp_path):
+    prefix, x, ref = _train_small(tmp_path)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 2)
+    params = {"arg:" + k: v for k, v in arg_params.items()}
+    params.update({"aux:" + k: v for k, v in aux_params.items()})
+    pred = mx.Predictor(sym_json, params,
+                        input_shapes={"data": (16, 1, 8, 8)}, ctx=mx.cpu())
+    pred.forward(data=x[:16])
+    np.testing.assert_allclose(pred.get_output(0).asnumpy(), ref[:16],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_missing_param_raises(tmp_path):
+    prefix, x, _ = _train_small(tmp_path)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with pytest.raises(ValueError):
+        mx.Predictor(sym_json, {}, input_shapes={"data": (16, 1, 8, 8)})
