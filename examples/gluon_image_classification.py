@@ -1,0 +1,95 @@
+"""Gluon model-zoo training: a zoo network + Trainer + hybridize.
+
+Capability twin of the reference's
+``example/gluon/image_classification.py`` (model_zoo net at line 119,
+``net.hybridize()`` at 168): picks any model-zoo architecture by name,
+trains it on a small synthetic image set with ``gluon.Trainer``, and
+asserts it learns. Defaults to mobilenet0_25 at 64x64 to stay quick (squeezenet's
+relu-after-final-conv head can start dead on synthetic data);
+any zoo name works (resnet18_v1, mobilenet0.25, densenet121, ...).
+
+Run:  python examples/gluon_image_classification.py --model mobilenet0_25
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+NUM_CLASSES = 4
+
+
+def synth_images(n=256, size=64, seed=0):
+    """4-class 3-channel textures: class = dominant channel + stripe
+    direction."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, NUM_CLASSES, n)
+    x = rng.rand(n, 3, size, size).astype(np.float32) * 0.3
+    yy, xx = np.mgrid[0:size, 0:size]
+    hstripe = ((yy // 8) % 2).astype(np.float32)
+    vstripe = ((xx // 8) % 2).astype(np.float32)
+    for c in range(NUM_CLASSES):
+        idx = y == c
+        x[idx, c % 3] += 0.5
+        x[idx, (c + 1) % 3] += 0.4 * (hstripe if c < 2 else vstripe)
+    return x, y.astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="gluon zoo classifier")
+    parser.add_argument("--model", type=str, default="mobilenet0_25")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--num-examples", type=int, default=256)
+    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--no-hybridize", action="store_true")
+    parser.add_argument("--min-acc", type=float, default=0.8,
+                        help="fail below this train accuracy (<=0 disables)")
+    args = parser.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.context.current_context()
+    net = vision.get_model(args.model, classes=NUM_CLASSES)
+    net.initialize(mx.init.Xavier(magnitude=2), ctx=ctx)
+    if not args.no_hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    x, y = synth_images(args.num_examples, args.image_size, seed=3)
+    bs = args.batch_size
+    for epoch in range(args.num_epochs):
+        perm = np.random.RandomState(epoch).permutation(len(y))
+        tot, steps = 0.0, 0
+        for s in range(0, len(y) - bs + 1, bs):
+            idx = perm[s:s + bs]
+            data = mx.nd.array(x[idx], ctx=ctx)
+            label = mx.nd.array(y[idx], ctx=ctx)
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(bs)
+            tot += float(loss.asnumpy().mean())
+            steps += 1
+        print("epoch %d loss %.4f" % (epoch, tot / max(steps, 1)))
+
+    correct = 0
+    for s in range(0, len(y) - bs + 1, bs):
+        out = net(mx.nd.array(x[s:s + bs], ctx=ctx))
+        correct += int((out.asnumpy().argmax(1) == y[s:s + bs]).sum())
+    acc = correct / ((len(y) // bs) * bs)
+    print("final train accuracy: %.4f (%s)" % (acc, args.model))
+    assert args.min_acc <= 0 or acc > args.min_acc, "failed to learn"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Gluon imperative training on the synthetic digit set.
+
+Capability twin of the reference's ``example/gluon/mnist.py``: a
+``nn.Sequential`` net trained with ``autograd.record`` + ``gluon.Trainer``,
+with ``--hybridize`` compiling the forward into one jitted XLA program
+(the HybridBlock/CachedOp path, reference gluon/block.py:283).
+
+Run:  python examples/gluon_mnist.py --num-epochs 3 --hybridize
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from train_mnist import synth_mnist
+
+
+def build_net():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(nn.Flatten())
+        net.add(nn.Dense(128, activation="relu"))
+        net.add(nn.Dense(64, activation="relu"))
+        net.add(nn.Dense(10))
+    return net
+
+
+def evaluate(net, x, y, batch_size, ctx):
+    import mxnet_tpu as mx
+    correct = 0
+    batch_size = min(batch_size, len(y))
+    n = (len(y) // batch_size) * batch_size
+    for s in range(0, n, batch_size):
+        out = net(mx.nd.array(x[s:s + batch_size], ctx=ctx))
+        correct += int((out.asnumpy().argmax(1) ==
+                        y[s:s + batch_size]).sum())
+    return correct / n
+
+
+def main():
+    parser = argparse.ArgumentParser(description="gluon digit classifier")
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--num-examples", type=int, default=2000)
+    parser.add_argument("--hybridize", action="store_true")
+    args = parser.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    ctx = mx.context.current_context()
+    x, y = synth_mnist(args.num_examples, seed=7)
+    split = int(0.9 * len(y))
+
+    net = build_net()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if args.hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    bs = args.batch_size
+    for epoch in range(args.num_epochs):
+        perm = np.random.RandomState(epoch).permutation(split)
+        tot = 0.0
+        for s in range(0, split - bs + 1, bs):
+            idx = perm[s:s + bs]
+            data = mx.nd.array(x[idx], ctx=ctx)
+            label = mx.nd.array(y[idx], ctx=ctx)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(bs)
+            tot += float(loss.asnumpy().mean())
+        print("epoch %d loss %.4f" % (epoch, tot / max(split // bs, 1)))
+
+    acc = evaluate(net, x[split:], y[split:], bs, ctx)
+    print("final validation accuracy: %.4f" % acc)
+    assert acc > 0.9, "failed to learn the synthetic digits"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -210,7 +210,8 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     const_of = {}
     for e in used:
         for k, c in zip(e.in_keys, e.in_consts):
-            const_of.setdefault(k, c)
+            if k is not None:   # None = non-array positional constant
+                const_of.setdefault(k, c)
 
     # Seeds: every (uid, version) of a marked variable that the slice consumes
     # but does not itself produce is a differentiation leaf. A variable

@@ -270,12 +270,22 @@ class HybridBlock(Block):
         n_in = len(flat_args)
         out_fmt = {}   # filled at trace time
 
-        def raw(*vals):
+        def raw(*vals, _rng=None):
             in_vals = vals[:n_in]
             p_vals = vals[n_in:]
             wrapped = [NDArray(v) for v in in_vals]
             for p, v in zip(params, p_vals):
                 p._data_override = NDArray(v)
+            # Thread the PRNG key explicitly: sampler ops (Dropout) split
+            # the thread-local key, which inside this trace would replace
+            # the global key with a tracer (UnexpectedTracerError at the
+            # next eager op). Seed the chain with the traced _rng and
+            # restore the caller's key after tracing; the concrete _rng is
+            # recorded in the tape attrs so backward replays exact masks.
+            from .. import random as _random
+            saved_key = _random.current_key()
+            if _rng is not None:
+                _random._state.key = _rng
             try:
                 with autograd.pause(train_mode=autograd.is_training()):
                     out = self.forward(*wrapped)
@@ -284,6 +294,7 @@ class HybridBlock(Block):
             finally:
                 for p in params:
                     p._data_override = None
+                _random._state.key = saved_key
             flat_out, fmt = _flatten(out)
             out_fmt["fmt"] = fmt
             out_fmt["n_out"] = len(flat_out)
@@ -321,7 +332,9 @@ class HybridBlock(Block):
 
         in_nds = list(flat_args) + [p.data() for p in params]
         in_vals = [a._data for a in in_nds]
-        all_outs = jitted(*in_vals)
+        from .. import random as _random
+        call_rng = _random.next_key()
+        all_outs = jitted(*in_vals, _rng=call_rng)
         n_out = out_fmt["n_out"]
         out_nds = [NDArray(o) for o in all_outs[:n_out]]
         # commit updated aux states (BatchNorm moving stats)
@@ -335,7 +348,7 @@ class HybridBlock(Block):
             # record the compiled forward as ONE composite tape op: backward
             # is one jax.vjp over the jitted program (CachedOp backward)
             in_keys = [(a._uid, a._version) for a in in_nds]
-            autograd._record_op(op, {}, in_keys, in_vals,
+            autograd._record_op(op, {"_rng": call_rng}, in_keys, in_vals,
                                 out_nds + aux_targets)
         fmt = out_fmt.get("fmt", 0)
         if fmt == 0:

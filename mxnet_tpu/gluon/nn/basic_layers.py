@@ -70,13 +70,14 @@ class Dense(HybridBlock):
     """Fully-connected layer (reference: basic_layers.py:104)."""
 
     def __init__(self, units, activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer="zeros",
-                 in_units=0, **kwargs):
+                 flatten=True, weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
         super().__init__(**kwargs)
         from ... import initializer as init_mod
         with self.name_scope():
             self._units = units
             self._in_units = in_units
+            self._flatten = flatten
             self.weight = self.params.get(
                 "weight", shape=(units, in_units),
                 init=weight_initializer, allow_deferred_init=True)
@@ -93,14 +94,18 @@ class Dense(HybridBlock):
                 self.act = None
 
     def shape_update(self, x, *args):
-        in_units = int(np.prod(x.shape[1:]))
+        # flatten=False applies the projection to the last axis only
+        # (reference basic_layers.py Dense(flatten=False))
+        in_units = (int(x.shape[-1]) if not self._flatten
+                    else int(np.prod(x.shape[1:])))
         self.weight.shape = (self._units, in_units)
         if self.bias is not None:
             self.bias.shape = (self._units,)
 
     def hybrid_forward(self, F, x, weight, bias=None):
         out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
-                               no_bias=bias is None)
+                               no_bias=bias is None,
+                               flatten=self._flatten)
         if self.act is not None:
             out = self.act(out)
         return out

@@ -414,9 +414,14 @@ def imperative_invoke(op: OpDef, *args, out=None, ctx=None, **attrs):
     recording = _autograd.is_recording() and not op.is_random
     if recording:
         # capture pre-mutation identities + values (reference saves node
-        # inputs at record time, src/ndarray/autograd.cc:129-227)
-        in_keys = [(a._uid, a._version) for a in nd_args]
-        in_consts = [a._data for a in nd_args]
+        # inputs at record time, src/ndarray/autograd.cc:129-227).
+        # Non-NDArray positionals (e.g. a positional reshape shape) get a
+        # None key so replay passes them through as constants — dropping
+        # them would re-run the op with defaults in backward.
+        in_keys = [(a._uid, a._version) if isinstance(a, NDArray) else None
+                   for a in args]
+        in_consts = [a._data if isinstance(a, NDArray) else a
+                     for a in args]
 
     _profiling = _profiler.state() == "run"
     if _profiling:

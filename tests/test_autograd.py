@@ -145,3 +145,19 @@ def test_attach_grad_detach():
         z = x * 3
     z.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0], rtol=1e-5)
+
+
+def test_positional_const_args_replay():
+    """Non-NDArray positionals (e.g. a positional reshape shape) must be
+    replayed as constants in backward — they are not tape inputs.
+    Regression: they were dropped, so backward re-ran the op with default
+    attrs (reshape got shape=None and crashed)."""
+    import mxnet_tpu as mx
+    x = mx.nd.array(np.arange(12, dtype=np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.reshape(x, (3, 4))      # shape passed positionally
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * np.arange(12, dtype=np.float32))

@@ -54,6 +54,27 @@ def test_model_parallel_lstm_cli():
 
 
 @pytest.mark.slow
+def test_gluon_mnist_cli():
+    out = _run("gluon_mnist.py", "--num-epochs", "2",
+               "--num-examples", "800", "--hybridize")
+    assert "final validation accuracy" in out
+
+
+@pytest.mark.nightly
+def test_gluon_image_classification_cli():
+    """Model-zoo net + Trainer + hybridize (reference
+    example/gluon/image_classification.py parity)."""
+    out = _run("gluon_image_classification.py", "--num-epochs", "10")
+    assert "final train accuracy" in out
+
+
+@pytest.mark.nightly
+def test_word_language_model_cli():
+    out = _run("word_language_model.py", "--num-epochs", "6")
+    assert "final validation perplexity" in out
+
+
+@pytest.mark.nightly
 def test_train_ssd_cli():
     """SSD detection convergence gate (SURVEY §2.15 example/ssd parity):
     multi-scale heads + MultiBox ops must learn to localize."""

@@ -239,3 +239,34 @@ def test_split_and_load_and_clip_global_norm():
     total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
     assert abs(total - 1.0) < 1e-5
     assert norm > 1.0
+
+
+def test_hybridized_dropout_no_tracer_leak():
+    """Dropout inside a hybridized block must not leak the traced PRNG key
+    into the global chain (regression: UnexpectedTracerError on the next
+    eager op), and training-mode masks must differ across calls."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dropout(0.5))
+    net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(8, 8).astype(np.float32))
+    with autograd.record():
+        o1 = net(x)
+        loss = (o1 * o1).sum()
+    loss.backward()                       # exact-mask replay path
+    with autograd.record():
+        o2 = net(x)
+    # fresh key per call: masks (hence outputs) differ while training
+    assert not np.allclose(o1.asnumpy(), o2.asnumpy())
+    # eager op after the hybridized call must not hit a leaked tracer
+    z = (mx.nd.random.uniform(shape=(2,)) + 1).asnumpy()
+    assert np.all(np.isfinite(z))
+    # inference mode: dropout off, deterministic
+    a = net(x).asnumpy()
+    b = net(x).asnumpy()
+    np.testing.assert_allclose(a, b)
