@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, alias
+from .. import amp
 
 # ------------------------------------------------------------------ dot
 
@@ -28,19 +29,23 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """
     a = lhs.T if transpose_a and lhs.ndim == 2 else (jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs)
     b = rhs.T if transpose_b and rhs.ndim == 2 else (jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs)
+    a, b, acc = amp.mxu_operands(a, b)
     if a.ndim == 1 and b.ndim == 1:
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(jnp.result_type(lhs, rhs))
-    out = jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
-    return out
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(jnp.result_type(a, b))
+    out = jnp.tensordot(a, b, axes=([a.ndim - 1], [0]), **acc)
+    return out.astype(jnp.result_type(a, b))
 
 
 @register("batch_dot", num_inputs=2)
 def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Batched matmul over leading axis (reference: matrix_op.cc batch_dot;
-    used heavily by attention-style models). Maps to one XLA BatchDot."""
+    used heavily by attention-style models). Maps to one XLA BatchDot on
+    the MXU — operands cast under the amp policy like FullyConnected."""
     dn = (((1,) if transpose_a else (2,), (2,) if transpose_b else (1,)),
           ((0,), (0,)))
-    return lax.dot_general(lhs, rhs, dimension_numbers=dn)
+    lhs, rhs, acc = amp.mxu_operands(lhs, rhs)
+    out = lax.dot_general(lhs, rhs, dimension_numbers=dn, **acc)
+    return out.astype(jnp.result_type(lhs, rhs))
 
 
 # ------------------------------------------------------------------ shape

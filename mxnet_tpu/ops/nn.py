@@ -321,6 +321,27 @@ def instance_norm(data, gamma, beta, eps=0.001):
 OP_REGISTRY["InstanceNorm"].num_inputs = 3
 
 
+@register("LayerNorm", num_inputs=3)
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5,
+               output_mean_var=False):
+    """Layer normalization over ``axis`` (upstream MXNet added this as
+    src/operator/nn/layer_norm.cc shortly after the referenced 0.11
+    snapshot; included here because it is load-bearing for transformer
+    workloads). Stats in fp32, output in the input dtype so bf16
+    activations stay bf16 under amp."""
+    ax = axis % data.ndim
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=ax, keepdims=True)
+    shp = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = (x32 - mean) * lax.rsqrt(var + eps) * gamma.reshape(shp) \
+        + beta.reshape(shp)
+    out = out.astype(data.dtype)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+    return out
+
+
 @register("L2Normalization")
 def l2_normalization(data, eps=1e-10, mode="instance"):
     """(reference: src/operator/l2_normalization.cc)."""

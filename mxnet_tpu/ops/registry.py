@@ -97,7 +97,7 @@ class OpDef:
                     names.append(p.name)
                 else:
                     break
-            if not names:
+            if not names and self.num_inputs != 0:
                 names = ["data"]
             if self.num_aux:
                 self.aux_input_names = names[-self.num_aux:]

@@ -520,9 +520,37 @@ def make_symbol_function(op: OpDef):
             # variadic (Concat-style): positional symbols are THE inputs
             attrs = {k: v for k, v in kwargs.items()}
             return _create(op, list(args), attrs, name)
-        for nm_i, a in zip(input_names_l, args):
-            inputs[nm_i] = a
         attrs = {}
+        # positional args: Symbols fill tensor-input slots; non-Symbols are
+        # positional *attrs* and map onto the op function's parameter at
+        # the same position (so sym.reshape(x, (1, 2, 3)) works like the
+        # imperative nd.reshape — previously the shape was silently lost)
+        fn_param_names = None
+        for i, a in enumerate(args):
+            if isinstance(a, Symbol):
+                if i < len(input_names_l):
+                    inputs[input_names_l[i]] = a
+                else:
+                    raise MXNetError(
+                        "%s: too many symbol inputs (expected %s)"
+                        % (op.name, input_names_l))
+            else:
+                if fn_param_names is None:
+                    import inspect as _inspect
+                    try:
+                        fn_param_names = [
+                            p.name for p in _inspect.signature(
+                                op.fn).parameters.values()
+                            if p.kind in (p.POSITIONAL_ONLY,
+                                          p.POSITIONAL_OR_KEYWORD)]
+                    except (TypeError, ValueError):
+                        fn_param_names = []
+                if i < len(fn_param_names):
+                    attrs[fn_param_names[i]] = a
+                else:
+                    raise MXNetError(
+                        "%s: unexpected positional argument %r"
+                        % (op.name, a))
         for k, v in kwargs.items():
             if isinstance(v, Symbol):
                 inputs[k] = v
@@ -778,6 +806,10 @@ def _derive_param_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]]):
                 elif opname == "InstanceNorm":
                     setvar(1, (ds[1],))
                     setvar(2, (ds[1],))
+                elif opname == "LayerNorm":
+                    ax = int(a.get("axis", -1)) % len(ds)
+                    setvar(1, (ds[ax],))
+                    setvar(2, (ds[ax],))
                 elif opname == "IdentityAttachKLSparseReg":
                     setvar(1, (int(np.prod(ds[1:])),))
                 elif opname == "Embedding":

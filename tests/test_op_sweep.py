@@ -109,6 +109,7 @@ SPECS = {
     # gradient), so finite differences can't validate it
     "IdentityAttachKLSparseReg": ([_pos(2, 3), _pos(3)], {}, "nograd"),
     "InstanceNorm": ([_rand(2, 3, 4, 4), _pos(3), _rand(3)], {}),
+    "LayerNorm": ([_rand(2, 3, 8), _pos(8), _rand(8)], {}),
     "L2Normalization": ([_rand(2, 3)], {}),
     "LRN": ([_rand(1, 4, 5, 5)], {"nsize": 3}),
     "LeakyReLU": ([_rand(2, 3)], {"act_type": "leaky"}),
