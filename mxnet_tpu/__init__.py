@@ -36,6 +36,7 @@ from . import lr_scheduler
 from . import callback
 from . import model
 from . import config
+from . import filesystem
 from . import io
 from . import image
 from . import profiler

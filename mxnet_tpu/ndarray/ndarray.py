@@ -556,16 +556,21 @@ def save(fname: str, data) -> None:
     manifest = np.array(
         ["dict" if keys is not None else "list"] + [k for k in payload.keys()],
         dtype=np.str_)
-    with open(fname, "wb") as f:
-        np.savez(f, __manifest__=manifest, **payload)
+    from .. import filesystem as _fs
+    with _fs.open_uri(fname, "w") as path:   # s3://, hdfs://, local
+        with open(path, "wb") as f:
+            np.savez(f, __manifest__=manifest, **payload)
 
 
 def load(fname: str):
-    """(reference: mx.nd.load)."""
-    with np.load(fname, allow_pickle=False) as zf:
-        manifest = [str(x) for x in zf["__manifest__"]]
-        kind, keys = manifest[0], manifest[1:]
-        out = {k: array(zf[k]) for k in keys}
+    """(reference: mx.nd.load; remote URIs stage via mx.filesystem like
+    dmlc::Stream)."""
+    from .. import filesystem as _fs
+    with _fs.open_uri(fname, "r") as path:
+        with np.load(path, allow_pickle=False) as zf:
+            manifest = [str(x) for x in zf["__manifest__"]]
+            kind, keys = manifest[0], manifest[1:]
+            out = {k: array(zf[k]) for k in keys}
     if kind == "list":
         return [out[k] for k in keys]
     return out

@@ -37,11 +37,20 @@ class MXRecordIO(object):
         self.open()
 
     def open(self):
+        from . import filesystem as _fs
+        path = self.uri
+        self._staged = None
+        if _fs.scheme_of(self.uri):
+            # remote URI (s3://, hdfs://, ...): stage through a local file
+            # the way dmlc::Stream wraps remote filesystems (SURVEY §2.11)
+            self._staged = _fs.open_uri(
+                self.uri, "r" if self.flag == "r" else "w")
+            path = self._staged.__enter__()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
+            self.handle = open(path, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            self.handle = open(path, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
@@ -50,6 +59,9 @@ class MXRecordIO(object):
         if self.handle is not None:
             self.handle.close()
             self.handle = None
+            if getattr(self, "_staged", None) is not None:
+                self._staged.__exit__(None, None, None)  # uploads on write
+                self._staged = None
 
     def reset(self):
         """(reference: recordio.py reset — reopen for reading)."""

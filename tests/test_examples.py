@@ -81,3 +81,12 @@ def test_train_ssd_cli():
     out = _run("train_ssd.py", "--num-epochs", "35",
                "--num-examples", "256", "--batch-size", "32")
     assert "mean IoU" in out
+
+
+@pytest.mark.nightly
+def test_train_rcnn_cli():
+    """Fast R-CNN-style ROI pipeline (reference example/rcnn parity):
+    ROIPooling + an in-graph CustomOp proposal-target must learn."""
+    out = _run("train_rcnn.py", "--num-epochs", "25",
+               "--num-examples", "128")
+    assert "final ROI classification accuracy" in out

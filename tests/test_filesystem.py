@@ -1,0 +1,88 @@
+"""Filesystem abstraction (mx.filesystem): URI-scheme dispatch, staging
+semantics, and its wiring into nd.save/load and RecordIO.
+
+Reference parity: dmlc-core's Stream layer, which lets checkpoints and
+RecordIO datasets live on s3://... URIs (SURVEY.md §2.11). No egress in
+this environment, so a custom test scheme plays the remote backend.
+"""
+import contextlib
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import filesystem as fs
+
+
+@pytest.fixture
+def memfs(tmp_path):
+    """A fake remote scheme backed by a hidden directory: mem://name."""
+    store = tmp_path / "remote_store"
+    store.mkdir()
+    log = {"reads": 0, "writes": 0}
+
+    @contextlib.contextmanager
+    def opener(path, mode):
+        import shutil
+        import tempfile
+        local = tempfile.NamedTemporaryFile(delete=False).name
+        try:
+            if "r" in mode:
+                log["reads"] += 1
+                shutil.copyfile(str(store / path), local)
+            yield local
+            if "w" in mode:
+                log["writes"] += 1
+                shutil.copyfile(local, str(store / path))
+        finally:
+            os.unlink(local)
+
+    fs.register_scheme("mem", opener)
+    yield store, log
+    fs._SCHEMES.pop("mem", None)
+
+
+def test_local_passthrough(tmp_path):
+    p = str(tmp_path / "a.txt")
+    with fs.open_uri(p, "w") as local:
+        assert local == p
+    with fs.open_uri("file://" + p, "w") as local:
+        assert local == p
+    assert fs.scheme_of("s3://b/k") == "s3"
+    assert fs.scheme_of("/plain/path") == ""
+
+
+def test_unknown_scheme_raises():
+    with pytest.raises(IOError):
+        with fs.open_uri("gopher://x/y"):
+            pass
+
+
+def test_s3_without_boto_raises_clearly():
+    with pytest.raises(IOError, match="boto3"):
+        with fs.open_uri("s3://bucket/key", "r"):
+            pass
+
+
+def test_nd_save_load_through_scheme(memfs):
+    store, log = memfs
+    data = {"w": mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))}
+    mx.nd.save("mem://ckpt.params", data)
+    assert log["writes"] == 1
+    out = mx.nd.load("mem://ckpt.params")
+    assert log["reads"] == 1
+    np.testing.assert_array_equal(out["w"].asnumpy(), data["w"].asnumpy())
+
+
+def test_recordio_through_scheme(memfs):
+    store, log = memfs
+    rec = mx.recordio.MXRecordIO("mem://data.rec", "w")
+    rec.write(b"alpha")
+    rec.write(b"beta" * 100)
+    rec.close()
+    assert log["writes"] == 1 and (store / "data.rec").exists()
+    rec = mx.recordio.MXRecordIO("mem://data.rec", "r")
+    assert rec.read() == b"alpha"
+    assert rec.read() == b"beta" * 100
+    rec.close()
