@@ -37,6 +37,7 @@ from . import callback
 from . import model
 from . import config
 from . import filesystem
+from . import storage
 from . import io
 from . import image
 from . import profiler

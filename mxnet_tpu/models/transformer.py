@@ -18,41 +18,57 @@ from .. import symbol as sym
 __all__ = ["get_symbol", "param_count"]
 
 
-def _attention(x, n_heads, d_model, T, name):
-    """Causal multi-head self-attention. x: (N, T, D)."""
+def _attention(x, n_heads, d_model, T, name, attention="dense"):
+    """Causal multi-head self-attention. x: (N, T, D).
+
+    attention="flash" routes the inner loop through the Pallas flash
+    kernel (ops/pallas/flash_attention.py — the §2.22 custom-kernel
+    path); "dense" is the batch_dot + masked-softmax composition.
+    """
     d_head = d_model // n_heads
     qkv = sym.FullyConnected(x, num_hidden=3 * d_model, flatten=False,
                              name="%s_qkv" % name)          # (N, T, 3D)
     qkv = sym.reshape(qkv, (-1, T, 3, n_heads, d_head))
     qkv = sym.transpose(qkv, axes=(2, 0, 3, 1, 4))          # (3,N,H,T,d)
-    q = sym.reshape(sym.slice_axis(qkv, axis=0, begin=0, end=1),
-                    (-1, T, d_head))                        # (N*H, T, d)
-    k = sym.reshape(sym.slice_axis(qkv, axis=0, begin=1, end=2),
-                    (-1, T, d_head))
-    v = sym.reshape(sym.slice_axis(qkv, axis=0, begin=2, end=3),
-                    (-1, T, d_head))
-    scores = sym.batch_dot(q, k, transpose_b=True)          # (N*H, T, T)
-    scores = scores * (1.0 / float(np.sqrt(d_head)))
-    # causal bias: -1e9 where key position > query position
-    pos = sym.arange(start=0, stop=T)
-    qpos = sym.reshape(pos, (T, 1))
-    kpos = sym.reshape(pos, (1, T))
-    future = sym.broadcast_greater(kpos, qpos)              # (T, T)
-    bias = sym.reshape(future * -1e9, (1, T, T))
-    scores = sym.broadcast_add(scores, bias)
-    att = sym.softmax(scores, axis=-1)
-    ctx = sym.batch_dot(att, v)                             # (N*H, T, d)
-    ctx = sym.reshape(ctx, (-1, n_heads, T, d_head))
-    ctx = sym.transpose(ctx, axes=(0, 2, 1, 3))             # (N, T, H, d)
+    if attention == "flash":
+        q = sym.reshape(sym.slice_axis(qkv, axis=0, begin=0, end=1),
+                        (-1, n_heads, T, d_head))           # (N, H, T, d)
+        k = sym.reshape(sym.slice_axis(qkv, axis=0, begin=1, end=2),
+                        (-1, n_heads, T, d_head))
+        v = sym.reshape(sym.slice_axis(qkv, axis=0, begin=2, end=3),
+                        (-1, n_heads, T, d_head))
+        ctx = sym.FlashAttention(q, k, v, causal=True)      # (N, H, T, d)
+        ctx = sym.transpose(ctx, axes=(0, 2, 1, 3))         # (N, T, H, d)
+    else:
+        q = sym.reshape(sym.slice_axis(qkv, axis=0, begin=0, end=1),
+                        (-1, T, d_head))                    # (N*H, T, d)
+        k = sym.reshape(sym.slice_axis(qkv, axis=0, begin=1, end=2),
+                        (-1, T, d_head))
+        v = sym.reshape(sym.slice_axis(qkv, axis=0, begin=2, end=3),
+                        (-1, T, d_head))
+        scores = sym.batch_dot(q, k, transpose_b=True)      # (N*H, T, T)
+        scores = scores * (1.0 / float(np.sqrt(d_head)))
+        # causal bias: -1e9 where key position > query position
+        pos = sym.arange(start=0, stop=T)
+        qpos = sym.reshape(pos, (T, 1))
+        kpos = sym.reshape(pos, (1, T))
+        future = sym.broadcast_greater(kpos, qpos)          # (T, T)
+        bias = sym.reshape(future * -1e9, (1, T, T))
+        scores = sym.broadcast_add(scores, bias)
+        att = sym.softmax(scores, axis=-1)
+        ctx = sym.batch_dot(att, v)                         # (N*H, T, d)
+        ctx = sym.reshape(ctx, (-1, n_heads, T, d_head))
+        ctx = sym.transpose(ctx, axes=(0, 2, 1, 3))         # (N, T, H, d)
     ctx = sym.reshape(ctx, (-1, T, d_model))
     return sym.FullyConnected(ctx, num_hidden=d_model, flatten=False,
                               name="%s_proj" % name)
 
 
-def _block(x, n_heads, d_model, d_ff, T, name):
+def _block(x, n_heads, d_model, d_ff, T, name, attention="dense"):
     ln1 = sym.LayerNorm(x, sym.Variable("%s_ln1_gamma" % name),
                         sym.Variable("%s_ln1_beta" % name))
-    x = x + _attention(ln1, n_heads, d_model, T, name + "_att")
+    x = x + _attention(ln1, n_heads, d_model, T, name + "_att",
+                       attention=attention)
     ln2 = sym.LayerNorm(x, sym.Variable("%s_ln2_gamma" % name),
                         sym.Variable("%s_ln2_beta" % name))
     h = sym.FullyConnected(ln2, num_hidden=d_ff, flatten=False,
@@ -64,7 +80,7 @@ def _block(x, n_heads, d_model, d_ff, T, name):
 
 
 def get_symbol(vocab_size=32000, num_layers=12, d_model=768, n_heads=12,
-               d_ff=None, seq_len=512):
+               d_ff=None, seq_len=512, attention="dense"):
     """Build the LM training symbol: embeddings -> L blocks -> tied-free
     output projection -> per-token SoftmaxOutput."""
     d_ff = d_ff or 4 * d_model
@@ -79,7 +95,8 @@ def get_symbol(vocab_size=32000, num_layers=12, d_model=768, n_heads=12,
                         name="pos_embed")                   # (T, D)
     x = sym.broadcast_add(tok, sym.reshape(pos, (1, T, d_model)))
     for i in range(num_layers):
-        x = _block(x, n_heads, d_model, d_ff, T, "layer%d" % i)
+        x = _block(x, n_heads, d_model, d_ff, T, "layer%d" % i,
+                   attention=attention)
     x = sym.LayerNorm(x, sym.Variable("final_ln_gamma"),
                       sym.Variable("final_ln_beta"))
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,

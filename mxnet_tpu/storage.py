@@ -1,0 +1,77 @@
+"""``mx.storage`` — memory spaces, host staging, and allocation stats.
+
+Reference: the Storage layer (``include/mxnet/storage.h:35-93``,
+``src/storage/``) with its device pools and ``PinnedMemoryStorage``
+(cudaMallocHost for fast DMA, SURVEY.md §2.2). On TPU, PJRT owns the
+allocator (the pooling job of GPUPooledStorageManager), so this layer
+exposes what remains meaningful:
+
+* **memory spaces** — every device advertises ``device`` (HBM),
+  ``pinned_host`` and ``unpinned_host`` kinds; ``as_in_memory`` moves an
+  NDArray between them. Pinned host memory is the TPU twin of the
+  reference's PinnedMemoryStorage: staged there, device transfers are
+  DMA-fast, and large cold tensors (optimizer state, checkpoint shards)
+  can live off-HBM.
+* **host offload** — ``offload``/``restore`` move whole param/state dicts
+  between HBM and pinned host memory (the activation/optimizer-state
+  offload pattern of large-model training).
+* **allocation stats** — ``memory_stats`` surfaces the PJRT allocator
+  counters (bytes_in_use, peak_bytes_in_use, ...) that the reference's
+  storage managers tracked internally.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .context import Context, current_context
+
+__all__ = ["memory_kinds", "memory_stats", "as_in_memory", "memory_kind_of",
+           "offload", "restore", "PINNED_HOST", "DEVICE"]
+
+DEVICE = "device"
+PINNED_HOST = "pinned_host"
+
+
+def _device(ctx: Optional[Context]):
+    return (ctx or current_context()).jax_device
+
+
+def memory_kinds(ctx: Optional[Context] = None) -> List[str]:
+    """Memory spaces addressable by ``ctx``'s device."""
+    return [m.kind for m in _device(ctx).addressable_memories()]
+
+
+def memory_stats(ctx: Optional[Context] = None) -> Dict[str, int]:
+    """PJRT allocator counters (empty dict when the backend exposes none,
+    e.g. CPU)."""
+    return dict(_device(ctx).memory_stats() or {})
+
+
+def memory_kind_of(arr) -> str:
+    """The memory space an NDArray currently lives in."""
+    data = arr.data if hasattr(arr, "data") else arr
+    kind = getattr(data.sharding, "memory_kind", None)
+    return kind or DEVICE
+
+
+def as_in_memory(arr, kind: str, ctx: Optional[Context] = None):
+    """Copy an NDArray into the given memory space of ``ctx``'s device
+    (reference parity: Storage::Alloc with a pinned/device context)."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+    from . import ndarray as nd
+    data = arr.data if hasattr(arr, "data") else arr
+    sharding = SingleDeviceSharding(_device(ctx), memory_kind=kind)
+    return nd.NDArray(jax.device_put(data, sharding))
+
+
+def offload(params: Dict[str, object], ctx: Optional[Context] = None,
+            kind: str = PINNED_HOST) -> Dict[str, object]:
+    """Stage a dict of NDArrays into host memory, freeing their HBM."""
+    return {k: as_in_memory(v, kind, ctx) for k, v in params.items()}
+
+
+def restore(params: Dict[str, object],
+            ctx: Optional[Context] = None) -> Dict[str, object]:
+    """Bring an offloaded dict back into device memory."""
+    return {k: as_in_memory(v, DEVICE, ctx) for k, v in params.items()}

@@ -1,0 +1,45 @@
+"""Storage layer (mx.storage): memory spaces, host staging, stats.
+
+Reference parity: include/mxnet/storage.h + PinnedMemoryStorage
+(SURVEY.md §2.2) — on TPU the allocator is PJRT's; what remains is the
+memory-space surface, which these tests exercise on the CPU backend
+(same kinds: device / pinned_host / unpinned_host).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import storage
+
+
+def test_memory_kinds_listed():
+    kinds = storage.memory_kinds(mx.cpu())
+    assert storage.DEVICE in kinds
+    assert storage.PINNED_HOST in kinds
+
+
+def test_roundtrip_through_pinned_host():
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert storage.memory_kind_of(x) == storage.DEVICE
+    h = storage.as_in_memory(x, storage.PINNED_HOST)
+    assert storage.memory_kind_of(h) == storage.PINNED_HOST
+    back = storage.as_in_memory(h, storage.DEVICE)
+    assert storage.memory_kind_of(back) == storage.DEVICE
+    np.testing.assert_array_equal(back.asnumpy(), x.asnumpy())
+
+
+def test_offload_restore_dict():
+    params = {"w": mx.nd.array(np.ones((4, 4), np.float32)),
+              "b": mx.nd.array(np.zeros((4,), np.float32))}
+    off = storage.offload(params)
+    assert all(storage.memory_kind_of(v) == storage.PINNED_HOST
+               for v in off.values())
+    # offloaded arrays are still usable as values
+    np.testing.assert_array_equal(off["w"].asnumpy(), params["w"].asnumpy())
+    on = storage.restore(off)
+    assert all(storage.memory_kind_of(v) == storage.DEVICE
+               for v in on.values())
+
+
+def test_memory_stats_shape():
+    stats = storage.memory_stats(mx.cpu())
+    assert isinstance(stats, dict)   # CPU backend may expose none

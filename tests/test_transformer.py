@@ -71,3 +71,30 @@ def test_symbol_positional_attrs():
     t = mx.sym.transpose(r, (1, 0))
     _, outs, _ = t.infer_shape(x=(3, 4))
     assert outs[0] == (6, 2)
+
+
+def test_transformer_flash_attention_matches_dense():
+    """attention='flash' (Pallas kernel path) must produce the same
+    logits as the dense composition under shared parameters."""
+    mods = {}
+    for att in ("dense", "flash"):
+        sym = transformer.get_symbol(vocab_size=50, num_layers=1,
+                                     d_model=32, n_heads=2, seq_len=128,
+                                     attention=att)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (2, 128))],
+                 label_shapes=[("softmax_label", (2, 128))])
+        mod.init_params(mx.init.Xavier())
+        mods[att] = mod
+    args, auxs = mods["dense"].get_params()
+    mods["flash"].set_params(args, auxs)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 50, (2, 128)).astype(np.float32)
+    db = mx.io.DataBatch(data=[mx.nd.array(x)],
+                         label=[mx.nd.array(np.zeros_like(x))])
+    outs = {}
+    for att, mod in mods.items():
+        mod.forward(db, is_train=False)
+        outs[att] = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(outs["flash"], outs["dense"],
+                               rtol=1e-4, atol=1e-5)
