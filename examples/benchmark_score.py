@@ -1,0 +1,95 @@
+"""Inference throughput across the model zoo — the perf-table script.
+
+Capability twin of the reference's
+``example/image-classification/benchmark_score.py``, the script that
+produced the published inference numbers in docs/how_to/perf.md (e.g.
+ResNet-50 batch 32: 713 img/s on P100 — BASELINE.md). Builds each network
+as a Symbol, binds a forward-only executor, and reports img/s per
+(network, batch size).
+
+Run:  python examples/benchmark_score.py --network resnet-50 --batch-sizes 1,32
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def get_symbol(network):
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import alexnet, lenet, mlp, resnet, vgg
+    if network.startswith("resnet-"):
+        return resnet.get_symbol(num_classes=1000,
+                                 num_layers=int(network.split("-")[1])), 224
+    if network.startswith("vgg-"):
+        return vgg.get_symbol(num_classes=1000,
+                              num_layers=int(network.split("-")[1])), 224
+    if network == "alexnet":
+        return alexnet.get_symbol(num_classes=1000), 224
+    if network == "lenet":
+        return lenet.get_symbol(num_classes=10), 28
+    raise ValueError("unknown network %r" % network)
+
+
+def score(network, batch_size, ctx, iters=20, warmup=3):
+    """img/s for one (network, batch) — the reference's score() shape."""
+    import mxnet_tpu as mx
+    sym, size = get_symbol(network)
+    channels = 1 if network == "lenet" else 3
+    mod = mx.mod.Module(sym, context=ctx)
+    # the loss head keeps a label arg; bind a dummy shape (forward-only
+    # softmax ignores it — same situation Predictor zero-fills)
+    mod.bind(data_shapes=[("data", (batch_size, channels, size, size))],
+             label_shapes=[("softmax_label", (batch_size,))],
+             for_training=False)
+    mod.init_params(mx.init.Xavier(magnitude=2))
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(data=[mx.nd.array(
+        rng.uniform(-1, 1, (batch_size, channels, size, size))
+        .astype(np.float32), ctx=ctx)])
+
+    def drain():
+        return float(mod.get_outputs()[0].asnumpy().ravel()[0])
+
+    for _ in range(warmup):
+        mod.forward(batch, is_train=False)
+    drain()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        mod.forward(batch, is_train=False)
+    drain()
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    parser = argparse.ArgumentParser(description="inference perf table")
+    parser.add_argument("--network", type=str, default="resnet-50",
+                        help="resnet-18/34/50/101/152, vgg-11/16/19, "
+                             "alexnet, lenet, or 'all'")
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--bf16", action="store_true",
+                        help="mixed-precision inference (mx.amp)")
+    args = parser.parse_args()
+
+    import mxnet_tpu as mx
+    if args.bf16:
+        mx.amp.init("bfloat16")
+    ctx = mx.tpu(0) if mx.num_devices("tpu") else mx.cpu(0)
+    print("context:", ctx)
+    nets = (["alexnet", "vgg-16", "resnet-50", "resnet-152"]
+            if args.network == "all" else [args.network])
+    for net in nets:
+        for bs in [int(b) for b in args.batch_sizes.split(",")]:
+            img_s = score(net, bs, ctx, iters=args.iters)
+            print("network: %-12s batch: %-4d  %.1f img/s" % (net, bs, img_s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

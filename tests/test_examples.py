@@ -90,3 +90,11 @@ def test_train_rcnn_cli():
     out = _run("train_rcnn.py", "--num-epochs", "25",
                "--num-examples", "128")
     assert "final ROI classification accuracy" in out
+
+
+@pytest.mark.slow
+def test_benchmark_score_cli():
+    """Inference perf-table script (reference benchmark_score.py parity)."""
+    out = _run("benchmark_score.py", "--network", "lenet",
+               "--batch-sizes", "4", "--iters", "3")
+    assert "img/s" in out
