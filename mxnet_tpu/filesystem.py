@@ -45,9 +45,7 @@ def _local(path: str, mode: str):
     yield path
 
 
-@contextlib.contextmanager
-def _s3(path: str, mode: str):
-    # path = bucket/key
+def _require_boto3():
     try:
         import boto3
     except ImportError:
@@ -55,6 +53,16 @@ def _s3(path: str, mode: str):
             "s3:// URIs need boto3 (not installed in this environment); "
             "stage the file locally or register_scheme('s3', ...) with a "
             "custom opener") from None
+    return boto3
+
+
+@contextlib.contextmanager
+def _s3(path: str, mode: str):
+    # path = bucket/key
+    if "a" in mode:
+        raise IOError("append mode is not supported for s3:// URIs "
+                      "(objects are immutable; rewrite with 'w')")
+    boto3 = _require_boto3()
     bucket, _, key = path.partition("/")
     s3 = boto3.client("s3")
     with tempfile.NamedTemporaryFile(delete=False) as tmp:
@@ -71,6 +79,9 @@ def _s3(path: str, mode: str):
 
 @contextlib.contextmanager
 def _hdfs(path: str, mode: str):
+    if "a" in mode:
+        raise IOError("append mode is not supported for hdfs:// URIs; "
+                      "rewrite with 'w'")
     try:
         from pyarrow import fs as pafs
     except ImportError:
@@ -116,11 +127,35 @@ def open_uri(uri: str, mode: str = "r"):
 
 
 def exists(uri: str) -> bool:
-    """Existence probe; remote schemes try a read open."""
-    if not scheme_of(uri):
-        return os.path.exists(uri)
+    """Existence probe. Local/file:// use os.path.exists; s3/hdfs use
+    cheap metadata probes (no download). Missing-dependency errors
+    propagate — a host without boto3 must not report checkpoints absent.
+    Custom schemes fall back to attempting a read open."""
+    scheme = scheme_of(uri)
+    if scheme in ("", "file"):
+        path = uri.split("://", 1)[1] if scheme else uri
+        return os.path.exists(path)
+    if scheme == "s3":
+        boto3 = _require_boto3()
+        bucket, _, key = uri.split("://", 1)[1].partition("/")
+        s3 = boto3.client("s3")
+        try:
+            s3.head_object(Bucket=bucket, Key=key)
+            return True
+        except s3.exceptions.ClientError:
+            return False
+    if scheme == "hdfs":
+        from pyarrow import fs as pafs   # ImportError propagates
+        host, _, rest = uri.split("://", 1)[1].partition("/")
+        info = pafs.HadoopFileSystem(host or "default").get_file_info(
+            "/" + rest)
+        return info.type != pafs.FileType.NotFound
+    if scheme not in _SCHEMES:
+        raise IOError("no filesystem registered for scheme %r" % scheme)
     try:
         with open_uri(uri, "r"):
             return True
-    except Exception:
+    except FileNotFoundError:
+        return False
+    except OSError:
         return False

@@ -45,8 +45,10 @@ class Predictor(object):
                  ctx: Optional[Context] = None):
         self._ctx = ctx or current_context()
         if isinstance(symbol_json, str) and symbol_json.endswith(".json"):
-            with open(symbol_json) as f:
-                symbol_json = f.read()
+            from . import filesystem as _fs
+            with _fs.open_uri(symbol_json, "r") as path:
+                with open(path) as f:
+                    symbol_json = f.read()
         self._symbol = load_json(symbol_json)
         self._arg_params, self._aux_params = self._load_params(params)
         self._input_shapes = dict(input_shapes)
@@ -157,8 +159,9 @@ class Predictor(object):
     def from_checkpoint(cls, prefix: str, epoch: int, input_shapes,
                         ctx: Optional[Context] = None) -> "Predictor":
         """Load ``prefix-symbol.json`` + ``prefix-%04d.params`` (the
-        Module/model checkpoint layout, reference model.py:370)."""
-        with open("%s-symbol.json" % prefix) as f:
-            sym_json = f.read()
-        return cls(sym_json, "%s-%04d.params" % (prefix, epoch),
+        Module/model checkpoint layout, reference model.py:370). The
+        prefix may be a remote URI (s3://...) — both files stage through
+        mx.filesystem."""
+        return cls("%s-symbol.json" % prefix,
+                   "%s-%04d.params" % (prefix, epoch),
                    input_shapes, ctx=ctx)

@@ -38,6 +38,8 @@ class MXRecordIO(object):
 
     def open(self):
         from . import filesystem as _fs
+        if self.flag not in ("r", "w"):   # before staging: no temp leak
+            raise ValueError("Invalid flag %s" % self.flag)
         path = self.uri
         self._staged = None
         if _fs.scheme_of(self.uri):

@@ -86,3 +86,54 @@ def test_recordio_through_scheme(memfs):
     assert rec.read() == b"alpha"
     assert rec.read() == b"beta" * 100
     rec.close()
+
+
+def test_exists_file_scheme_checks_filesystem(tmp_path):
+    missing = "file://" + str(tmp_path / "nope.bin")
+    assert not fs.exists(missing)
+    p = tmp_path / "yes.bin"
+    p.write_bytes(b"x")
+    assert fs.exists("file://" + str(p))
+
+
+def test_append_mode_rejected_for_remote():
+    with pytest.raises(IOError, match="append"):
+        with fs.open_uri("s3://bucket/key", "a"):
+            pass
+
+
+def test_recordio_invalid_flag_no_staging(memfs):
+    store, log = memfs
+    with pytest.raises(ValueError):
+        mx.recordio.MXRecordIO("mem://x.rec", "a")
+    assert log["writes"] == 0 and log["reads"] == 0
+
+
+def test_predictor_checkpoint_through_scheme(memfs, tmp_path):
+    store, log = memfs
+    # train a tiny model, checkpoint locally, copy into the fake remote
+    import shutil
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    shutil.copyfile(prefix + "-symbol.json", str(store / "m-symbol.json"))
+    shutil.copyfile(prefix + "-0001.params", str(store / "m-0001.params"))
+    pred = mx.Predictor.from_checkpoint("mem://m", 1,
+                                        input_shapes={"data": (8, 4)},
+                                        ctx=mx.cpu())
+    pred.forward(data=x)
+    ref = mx.Predictor.from_checkpoint(prefix, 1,
+                                       input_shapes={"data": (8, 4)},
+                                       ctx=mx.cpu())
+    ref.forward(data=x)
+    np.testing.assert_allclose(pred.get_output(0).asnumpy(),
+                               ref.get_output(0).asnumpy())
