@@ -98,3 +98,11 @@ def test_benchmark_score_cli():
     out = _run("benchmark_score.py", "--network", "lenet",
                "--batch-sizes", "4", "--iters", "3")
     assert "img/s" in out
+
+
+@pytest.mark.slow
+def test_fine_tune_cli():
+    """Checkpoint -> new head -> frozen-backbone fine-tune (reference
+    fine-tune.py parity: set_params(allow_missing) + fixed_param_names)."""
+    out = _run("fine_tune.py")
+    assert "fine-tuned" in out
