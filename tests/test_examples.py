@@ -106,3 +106,11 @@ def test_fine_tune_cli():
     fine-tune.py parity: set_params(allow_missing) + fixed_param_names)."""
     out = _run("fine_tune.py")
     assert "fine-tuned" in out
+
+
+@pytest.mark.nightly
+def test_dcgan_cli():
+    """Adversarial two-Trainer training (reference example/gluon/dcgan.py
+    parity): D margin must grow, G statistics must move toward the data."""
+    out = _run("dcgan.py", "--num-epochs", "4")
+    assert "generated mean" in out
