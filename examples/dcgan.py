@@ -82,6 +82,9 @@ def main():
 
     x, _ = synth_mnist(args.num_examples, seed=3)
     B = args.batch_size
+    if B > len(x):
+        parser.error("--batch-size %d exceeds --num-examples %d"
+                     % (B, len(x)))
     rng = np.random.RandomState(0)
     real_label = mx.nd.array(np.ones(B, np.float32), ctx=ctx)
     fake_label = mx.nd.array(np.zeros(B, np.float32), ctx=ctx)

@@ -8,8 +8,10 @@ from the checkpoint (``set_params(allow_missing=True)`` + fresh init for
 the new head — the reference's get_fine_tune_model flow).
 
 Here: pretrain LeNet-ish features on 4 synthetic "pretraining" classes,
-then fine-tune to a 3-class relabeling and assert the fine-tuned model
-beats training the same net from scratch under the same budget.
+then fine-tune to a 3-class relabeling with the backbone frozen. Gates:
+the backbone verifiably carries the checkpoint weights (transfer is not
+a silent no-op) and the fine-tuned head learns the new task; the
+from-scratch number is printed for comparison.
 
 Run:  python examples/fine_tune.py
 """
@@ -24,16 +26,13 @@ import numpy as np
 
 
 def synth_shapes(n, num_classes, seed):
-    """Class = which quadrant holds a bright blob + stripe phase."""
+    """Class = which quadrant holds a bright blob (num_classes <= 4)."""
     rng = np.random.RandomState(seed)
     y = rng.randint(0, num_classes, n)
     x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.3
     for i in range(n):
-        c = y[i]
-        qy, qx = divmod(c % 4, 2)
+        qy, qx = divmod(int(y[i]), 2)
         x[i, 0, 14 * qy:14 * qy + 12, 14 * qx:14 * qx + 12] += 0.6
-        if c >= 4:
-            x[i, 0, ::3, :] += 0.3
     return x, y.astype(np.float32)
 
 
@@ -99,7 +98,8 @@ def main():
     xp, yp = synth_shapes(1000, 4, seed=1)
     pre_mod, pre_acc = train(with_head(feats, 4, "fc_pre"), xp, yp,
                              args.pretrain_epochs, 0.05, ctx)
-    prefix = os.path.join(tempfile.mkdtemp(), "pre")
+    tmpdir = tempfile.TemporaryDirectory()
+    prefix = os.path.join(tmpdir.name, "pre")
     pre_mod.save_checkpoint(prefix, args.pretrain_epochs)
     print("pretrain accuracy: %.3f" % pre_acc)
 
@@ -113,15 +113,21 @@ def main():
     # head's initial gradients can't wreck the pretrained features —
     # without this, head-induced noise sets the backbone back below the
     # from-scratch baseline at this budget
-    _, tuned_acc = train(with_head(feats, 3, "fc_new"), xt, yt,
-                         args.tune_epochs, 0.05, ctx,
-                         arg_params=arg_params,
-                         fixed_param_names=list(arg_params))
+    tuned_mod, tuned_acc = train(with_head(feats, 3, "fc_new"), xt, yt,
+                                 args.tune_epochs, 0.05, ctx,
+                                 arg_params=arg_params,
+                                 fixed_param_names=list(arg_params))
+    # the transfer must not be a silent no-op: the frozen backbone still
+    # carries the checkpoint weights after training
+    got = tuned_mod.get_params()[0]["c1_weight"].asnumpy()
+    want = arg_params["c1_weight"].asnumpy()
+    assert np.allclose(got, want), "backbone did not transfer from ckpt"
     _, scratch_acc = train(with_head(feats, 3, "fc_new"), xt, yt,
                            args.tune_epochs, 0.05, ctx)
     print("fine-tuned: %.3f   from scratch (same budget): %.3f"
           % (tuned_acc, scratch_acc))
     assert tuned_acc > 0.9, "fine-tuned model failed to learn"
+    tmpdir.cleanup()
     return 0
 
 
