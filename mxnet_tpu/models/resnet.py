@@ -127,20 +127,26 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
     """(reference: symbols/resnet.py get_symbol)."""
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
-    if num_layers not in _CONFIGS:
-        raise ValueError("unsupported resnet depth %d" % num_layers)
-    units, bottleneck = _CONFIGS[num_layers]
     if image_shape[1] <= 32:
-        # cifar config (reference resnet.py: per-depth unit derivation)
+        # cifar config (reference resnet.py: per-depth unit derivation —
+        # any depth with (n-2) % 9 == 0 (bottleneck) or % 6 == 0 works,
+        # e.g. resnet-8/20/56/110)
         if (num_layers - 2) % 9 == 0 and num_layers >= 164:
             per = (num_layers - 2) // 9
             units, bottleneck = [per] * 3, True
         elif (num_layers - 2) % 6 == 0:
             per = (num_layers - 2) // 6
             units, bottleneck = [per] * 3, False
+        else:
+            raise ValueError(
+                "unsupported small-image resnet depth %d "
+                "(need (n-2) %% 6 == 0)" % num_layers)
         filter_list = [16, 64, 128, 256] if bottleneck else [16, 16, 32, 64]
         num_stages = 3
     else:
+        if num_layers not in _CONFIGS:
+            raise ValueError("unsupported resnet depth %d" % num_layers)
+        units, bottleneck = _CONFIGS[num_layers]
         filter_list = [64, 256, 512, 1024, 2048] if bottleneck else \
             [64, 64, 128, 256, 512]
         num_stages = 4

@@ -114,3 +114,12 @@ def test_dcgan_cli():
     parity): D margin must grow, G statistics must move toward the data."""
     out = _run("dcgan.py", "--num-epochs", "4")
     assert "generated mean" in out
+
+
+@pytest.mark.nightly
+def test_train_cifar10_cli():
+    """Color RecordIO + crop/mirror augmentation through the fit harness
+    (reference train_cifar10.py parity, small-image resnet)."""
+    out = _run("train_cifar10.py", "--num-epochs", "6",
+               "--num-examples", "1200")
+    assert "final validation accuracy" in out
