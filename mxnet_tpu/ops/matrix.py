@@ -166,6 +166,28 @@ def reverse(data, axis=0):
     return jnp.flip(data, axis=axes)
 
 
+@register("Crop", num_inputs=None)
+def crop_like(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+              num_args=None):
+    """Spatial crop (reference: src/operator/crop.cc): with one input,
+    crop to ``h_w``; with two, crop data (input 0) to the spatial size of
+    crop_like (input 1). NCHW layout, crops the trailing two axes."""
+    data = inputs[0]
+    H, W = data.shape[-2], data.shape[-1]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[-2], inputs[1].shape[-1]
+    else:
+        th, tw = int(h_w[0]) or H, int(h_w[1]) or W
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    if y0 + th > H or x0 + tw > W:
+        raise ValueError("Crop: window %dx%d at (%d, %d) exceeds input "
+                         "%dx%d" % (th, tw, y0, x0, H, W))
+    return data[..., y0:y0 + th, x0:x0 + tw]
+
+
 @register("SwapAxis", aliases=("swapaxes",))
 def swapaxes(data, dim1=0, dim2=0):
     """Swap two axes (reference: src/operator/swapaxis.cc)."""

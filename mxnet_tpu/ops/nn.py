@@ -649,3 +649,8 @@ def identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
 
 
 OP_REGISTRY["IdentityAttachKLSparseReg"].num_aux = 1
+
+# legacy-generation alias (reference: src/operator/convolution_v1.cc — the
+# pre-NNVM Convolution registration; identical math on the XLA path)
+from .registry import alias as _alias  # noqa: E402
+_alias("Convolution", "Convolution_v1")

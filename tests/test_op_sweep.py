@@ -108,6 +108,8 @@ SPECS = {
     # backward intentionally attaches a KL penalty (not the forward's
     # gradient), so finite differences can't validate it
     "IdentityAttachKLSparseReg": ([_pos(2, 3), _pos(3)], {}, "nograd"),
+    "Crop": ([_rand(1, 2, 6, 6), _rand(1, 2, 4, 4)],
+             {"offset": (1, 1)}),
     "InstanceNorm": ([_rand(2, 3, 4, 4), _pos(3), _rand(3)], {}),
     "LayerNorm": ([_rand(2, 3, 8), _pos(8), _rand(8)], {}),
     "L2Normalization": ([_rand(2, 3)], {}),
