@@ -364,6 +364,10 @@ class _NativePipe:
         self._shape = (it.batch_size, c, h, w)
         self._label_shape = (it.batch_size, it.label_width)
         self.handle = lib.mxpipe_create(rec, ctypes.byref(cfg))
+        if not self.handle:
+            # caller will discard us on a null handle; release the mmap+fd
+            self.handle = None
+            self.close()
 
     def start_epoch(self, order):
         import numpy as _np

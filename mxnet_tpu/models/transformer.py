@@ -111,7 +111,10 @@ def param_count(vocab_size=32000, num_layers=12, d_model=768, n_heads=12,
                 d_ff=None, seq_len=512):
     """Analytic parameter count (for FLOP estimates)."""
     d_ff = d_ff or 4 * d_model
-    per_layer = (3 * d_model + 1) * d_model + (d_model + 1) * d_model \
+    # qkv: weight D x 3D plus a 3D bias (the fused projection has one bias
+    # element per output unit, i.e. 3*d_model of them)
+    per_layer = 3 * d_model * d_model + 3 * d_model \
+        + (d_model + 1) * d_model \
         + (d_model + 1) * d_ff + (d_ff + 1) * d_model + 4 * d_model
     return (vocab_size * d_model + seq_len * d_model
             + num_layers * per_layer + 2 * d_model

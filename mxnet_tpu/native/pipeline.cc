@@ -78,6 +78,10 @@ const uint8_t* ParseHeader(const uint8_t* buf, int64_t len, int label_width,
   int64_t rest = len - 24;
   if (h.flag > 0) {  // multi-label: flag = count of float32 labels
     int64_t nl = h.flag;
+    if (24 + 4 * nl > len) {  // corrupted/truncated record: labels would
+      *payload_len = -1;      // run past the mmap; fail the record
+      return nullptr;
+    }
     for (int i = 0; i < label_width; ++i) {
       float v = 0.f;
       if (i < nl) std::memcpy(&v, p + 4 * i, 4);
@@ -103,6 +107,7 @@ bool ProcessOne(Pipe* pp, int64_t rec_idx, uint64_t rng_seed, float* dst,
   int64_t payload_len;
   const uint8_t* payload =
       ParseHeader(buf, len, c.label_width, label_out, &payload_len);
+  if (payload == nullptr || payload_len <= 0) return false;
 
   uint8_t* img;
   int h, w, ch;

@@ -179,7 +179,8 @@ void* mxrio_writer_open(const char* path) {
 
 int64_t mxrio_writer_write(void* handle, const uint8_t* buf, int64_t len) {
   Writer* w = static_cast<Writer*>(handle);
-  int64_t at = w->pos;
+  if (len < 0 || len >= (int64_t{1} << 29)) return -1;  // lrec length field
+  int64_t at = w->pos;                                  // holds 29 bits only
   uint32_t hdr[2] = {kMagic,
                      static_cast<uint32_t>(len) & ((1u << 29) - 1)};
   if (std::fwrite(hdr, 4, 2, w->f) != 2) return -1;

@@ -61,11 +61,14 @@ def moe_apply(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
     gate_vals, gate_idx = jax.lax.top_k(probs, k)      # (T, k)
 
     # position of each (token, choice) in its expert's capacity buffer:
-    # count prior assignments to the same expert in (token, choice) order
-    choice_mask = jax.nn.one_hot(gate_idx, E, dtype=x.dtype)  # (T, k, E)
-    flat = choice_mask.reshape(T * k, E)
+    # count prior assignments to the same expert in (token, choice) order.
+    # Bookkeeping must stay int32: bf16 activations can't represent counts
+    # above 256, which silently corrupts capacity slots for T*k > 256.
+    choice_mask_i = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (T, k, E)
+    flat = choice_mask_i.reshape(T * k, E)
     pos = jnp.cumsum(flat, axis=0) - flat              # (T*k, E)
     pos = jnp.sum(pos * flat, axis=-1).reshape(T, k)   # (T, k)
+    choice_mask = choice_mask_i.astype(x.dtype)
     keep = (pos < C).astype(x.dtype)
     gate_vals = gate_vals * keep
     denom = jnp.sum(gate_vals, axis=-1, keepdims=True)

@@ -6,9 +6,10 @@ IRHeader, pack/unpack/pack_img/unpack_img) over dmlc-core's RecordIO codec
 
 Binary layout (dmlc recordio): per record a uint32 magic ``0xced7230a``, a
 uint32 ``lrecord`` whose upper 3 bits are a continuation flag and lower 29
-bits the payload length, then the payload padded to 4-byte alignment. This
-implementation writes single-part records (cflag=0) and reads multi-part
-ones.
+bits the payload length, then the payload padded to 4-byte alignment.
+Payloads that fit 29 bits are written as single cflag=0 records; larger
+ones are chained as cflag 1/2/3 parts (dmlc-core writer behavior), and the
+reader reassembles either form.
 """
 from __future__ import annotations
 
@@ -71,14 +72,31 @@ class MXRecordIO(object):
         self.open()
 
     def write(self, buf: bytes):
-        """(reference: recordio.py write)."""
+        """(reference: recordio.py write).
+
+        Payloads >= 2**29 bytes don't fit the 29-bit length field and are
+        split into a cflag 1/2/3 multi-part chain, mirroring dmlc-core's
+        writer; ``read`` already reassembles such chains.
+        """
         assert self.writable
-        length = len(buf)
-        self.handle.write(struct.pack("<II", _kMagic, length & ((1 << 29) - 1)))
-        self.handle.write(buf)
-        pad = (-length) % 4
-        if pad:
-            self.handle.write(b"\x00" * pad)
+        _max = (1 << 29) - 1
+        chunks = [buf[i:i + _max] for i in range(0, len(buf), _max)] or [b""]
+        for i, chunk in enumerate(chunks):
+            if len(chunks) == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == len(chunks) - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            length = len(chunk)
+            self.handle.write(
+                struct.pack("<II", _kMagic, (cflag << 29) | length))
+            self.handle.write(chunk)
+            pad = (-length) % 4
+            if pad:
+                self.handle.write(b"\x00" * pad)
 
     def read(self) -> Optional[bytes]:
         """(reference: recordio.py read). Returns None at EOF."""
@@ -87,7 +105,10 @@ class MXRecordIO(object):
         while True:
             header = self.handle.read(8)
             if len(header) < 8:
-                return b"".join(parts) if parts else None
+                if parts:  # EOF mid-chain: a truncated multi-part record
+                    raise IOError(
+                        "truncated multi-part record at EOF in %s" % self.uri)
+                return None
             magic, lrec = struct.unpack("<II", header)
             if magic != _kMagic:
                 raise IOError("Invalid magic number in record file %s" % self.uri)

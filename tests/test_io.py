@@ -182,3 +182,30 @@ def test_resize_iter():
     base = mx.io.NDArrayIter(data, None, batch_size=4)
     it = mx.io.ResizeIter(base, 5)
     assert len(list(it)) == 5
+
+
+def test_recordio_multipart_write_roundtrip(tmp_path):
+    # payloads >= 2**29 bytes are split into a cflag 1/2/3 chain
+    # (dmlc-core writer behavior); small payloads stay single-part, and
+    # the reader must reassemble a hand-forged chain.
+    path = str(tmp_path / "big.rec")
+    rec = mx.recordio.MXRecordIO(path, "w")
+    payload = bytes(range(256)) * 40                      # 10240 bytes
+    rec.write(payload)
+    rec.close()
+    rec = mx.recordio.MXRecordIO(path, "r")
+    assert rec.read() == payload
+    rec.close()
+    # now forge a 3-part chain on disk and check the reader reassembles it
+    kmagic = 0xced7230a
+    with open(str(tmp_path / "chain.rec"), "wb") as f:
+        parts = [payload[:4000], payload[4000:8000], payload[8000:]]
+        for i, chunk in enumerate(parts):
+            cflag = 1 if i == 0 else (3 if i == len(parts) - 1 else 2)
+            f.write(struct.pack("<II", kmagic, (cflag << 29) | len(chunk)))
+            f.write(chunk)
+            f.write(b"\x00" * ((-len(chunk)) % 4))
+    rec = mx.recordio.MXRecordIO(str(tmp_path / "chain.rec"), "r")
+    assert rec.read() == payload
+    assert rec.read() is None
+    rec.close()

@@ -106,3 +106,27 @@ def test_moe_gradients_flow_and_aux_balances():
     # perfectly uniform routing minimizes the GShard aux loss at 1.0
     _, aux = moe_apply(params, x)
     assert float(aux) >= 1.0 - 1e-3
+
+
+def test_moe_bf16_routing_exact_beyond_256_assignments():
+    # Routing bookkeeping must be exact in int32: with bf16 activations the
+    # cumsum position counters saturate at 256 (bf16 has 8 mantissa bits),
+    # so tokens past the 256th collide in one capacity slot and their
+    # dispatched activations get summed together. Force every token to one
+    # expert with ample capacity; each token's output must then equal the
+    # dense bf16 FFN of that token alone.
+    rng = np.random.RandomState(7)
+    T, D, H, E = 1024, 16, 32, 4          # 1024 assignments to expert 0
+    params = moe_init(rng, D, H, E)
+    params["router"] = np.zeros((D, E), np.float32)
+    x = rng.normal(0, 1, (T, D)).astype(np.float32)
+    x[:, 0] = 5.0                          # all tokens prefer expert 0
+    params["router"][0, 0] = 10.0
+    p16 = {k: jnp.asarray(v, jnp.bfloat16) for k, v in params.items()}
+    x16 = jnp.asarray(x, jnp.bfloat16)
+
+    out, _ = moe_apply(p16, x16, top_k=1, capacity_factor=float(E))
+    dense = jax.nn.gelu(x16 @ p16["wi"][0]) @ p16["wo"][0]
+    err = jnp.max(jnp.abs((out - dense).astype(jnp.float32)))
+    scale = float(jnp.max(jnp.abs(dense.astype(jnp.float32)))) + 1e-6
+    assert float(err) / scale < 0.05, float(err) / scale
