@@ -23,6 +23,10 @@ import json
 import sys
 import time
 
+
+def _note(msg):
+    print(msg, file=sys.stderr, flush=True)
+
 sys.path.insert(0, __file__.rsplit("/", 1)[0] if "/" in __file__ else ".")
 
 BASELINE_IMG_S = 181.53   # P100 training, ResNet-50 batch 32
@@ -51,12 +55,16 @@ def _peak_flops(device_kind: str):
 def bench_transformer(mx, np, jax, peak):
     """Transformer-LM fused train step: tokens/s + MFU on one chip."""
     from mxnet_tpu.models import transformer
-    # ~600M-param decoder LM: widest matmuls that fit one chip's HBM at
-    # B=8/T=1024 without remat (measured: the MFU sweet spot on this chip)
-    L, D, H, T, V = 6, 2048, 16, 1024, 32000
+    # ~0.67B-param GPT-2-medium-class decoder LM with the Pallas flash
+    # attention kernel (fused fwd + dQ/dK/dV backward). Measured sweep on
+    # this chip (see docs/perf.md): flash beats dense batch_dot attention
+    # and L12/B8 is the MFU sweet spot; deeper/wider configs (1.5B) hit
+    # the HBM ceiling with f32 master weights.
+    L, D, H, T, V = 12, 2048, 16, 1024, 32000
     B = 8
+    _note("bench: transformer bind start")
     sym = transformer.get_symbol(vocab_size=V, num_layers=L, d_model=D,
-                                 n_heads=H, seq_len=T)
+                                 n_heads=H, seq_len=T, attention="flash")
     mod = mx.mod.Module(sym, context=mx.tpu(0))
     mod.bind(data_shapes=[("data", (B, T))],
              label_shapes=[("softmax_label", (B, T))])
@@ -73,9 +81,11 @@ def bench_transformer(mx, np, jax, peak):
         return float(np.asarray(
             mod._exec.arg_dict["lm_head_weight"].data[0, 0]))
 
+    _note("bench: transformer bound; compiling")
     for _ in range(2):
         mod._fit_step(db)
     drain()
+    _note("bench: transformer timing")
     iters = 8
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -103,6 +113,7 @@ def main():
     iters = ITERS if on_tpu else 3
 
     mx.amp.init("bfloat16")   # bf16 MXU compute, fp32 master weights
+    _note("bench: resnet bind start")
 
     sym = resnet.get_symbol(num_classes=1000, num_layers=50)
     mod = mx.mod.Module(sym, context=ctx)
@@ -130,9 +141,11 @@ def main():
         return float(np.asarray(
             mod._exec.arg_dict["fc1_weight"].data[0, 0]))
 
+    _note("bench: resnet compiling")
     for _ in range(WARMUP):
         mod._fit_step(dbatch)
     drain()
+    _note("bench: resnet timing")
 
     t0 = time.perf_counter()
     for _ in range(iters):

@@ -12,9 +12,13 @@ grid axis walks KV tiles, carrying (m, l, acc) in VMEM scratch that lives
 across grid steps; the normalized output tile is written on the last KV
 step. QKᵀ and PV both hit the MXU with fp32 accumulation.
 
-Backward is the standard XLA recompute path behind ``jax.custom_vjp`` —
-the memory win matters in the forward (inference / activation footprint);
-a fused backward kernel is a further optimization, not a semantics change.
+Backward is fused too (FlashAttention-2 style): the forward additionally
+writes the per-row logsumexp, and two Pallas kernels — one accumulating
+dQ over KV tiles, one accumulating dK/dV over Q tiles — rebuild each
+P tile as ``exp(s - lse)`` so the S×S probability matrix never hits HBM
+in either direction. ``exp(s - lse)`` needs no running rescale: lse is
+the final statistic, making the backward tiles embarrassingly
+order-independent (unlike the forward's online softmax).
 
 Off-TPU the same kernel runs in interpreter mode (exact, slow) so the
 CPU test rig can check numerics; ``flash_attention`` falls back to plain
@@ -32,10 +36,14 @@ from jax import lax
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
+# lse/delta ride as (BH, S, _LANES) with the row value replicated across
+# lanes: Mosaic wants >=2D tiles whose last block dim divides 128 OR equals
+# the array dim — 8 lanes satisfies the latter at 1/16th the HBM of 128
+_LANES = 8
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-               scale, causal, block_q, block_k, skip_masked):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+               *, scale, causal, block_q, block_k, skip_masked):
     import jax.experimental.pallas as pl
 
     kv_step = pl.program_id(2)
@@ -86,6 +94,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finish():
         denom = jnp.maximum(l_scr[:, 0], 1e-37)
         o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+        # lane-replicated across the _LANES trailing dim (see _LANES note)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_scr[:, 0] + jnp.log(denom))[:, None], lse_ref[0].shape)
 
 
 def _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -101,14 +112,18 @@ def _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret):
                                skip_masked=not interpret)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32)),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -116,6 +131,168 @@ def _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+                      skip_masked):
+    """dQ accumulator: grid (BH, nq, nk), KV tiles innermost.
+
+    Rebuilds P = exp(s - lse) from the saved logsumexp (exact — lse is the
+    final softmax statistic, so no online rescaling is needed), then
+    dS = P * (dO·Vᵀ - Δ) and dQ += dS·K, all tiles resident in VMEM.
+    """
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (j * block_k <= (pl.program_id(1) + 1) * block_q - 1) \
+        if (causal and skip_masked) else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = pl.program_id(1) * block_q + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, 0:1])            # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta_ref[0][:, 0:1]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                       block_q, block_k, skip_masked):
+    """dK/dV accumulator: grid (BH, nk, nq), Q tiles innermost.
+
+    dV += Pᵀ·dO and dK += dSᵀ·Q per Q tile; writing per-KV-tile outputs
+    from a KV-major grid means no cross-tile races and no atomics.
+    """
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: a Q tile entirely above (before) this KV tile sees none of it
+    live = ((i + 1) * block_q - 1 >= pl.program_id(1) * block_k) \
+        if (causal and skip_masked) else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            q_pos = i * block_q + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = pl.program_id(1) * block_k + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, 0:1])              # (bq, bk)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        ds = p * (dp - delta_ref[0][:, 0:1]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, d)
+
+    @pl.when(i == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _fa_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+                 interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, D = q.shape
+    Sk = k.shape[1]
+    nq = S // block_q
+    nk = Sk // block_k
+    # Δ_i = rowsum(dO ⊙ O): tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # (BH, S)
+    delta = jnp.broadcast_to(delta[:, :, None], (BH, S, _LANES))
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, skip_masked=not interpret)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, **common),
+        out_shape=(jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ),
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _xla_attention(q, k, v, scale, causal):
@@ -134,19 +311,21 @@ def _xla_attention(q, k, v, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _fa(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    out, _ = _fa_forward(q, k, v, scale, causal, block_q, block_k,
+                         interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _fa_forward(q, k, v, scale, causal, block_q, block_k,
+                           interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, scale,
-                                                       causal), q, k, v)
-    return vjp(g.astype(jnp.float32).astype(q.dtype))
+    q, k, v, out, lse = res
+    return _fa_backward(q, k, v, out, lse, g.astype(q.dtype), scale,
+                        causal, block_q, block_k, interpret)
 
 
 _fa.defvjp(_fa_fwd, _fa_bwd)
@@ -161,7 +340,9 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     ``block_k`` — padded keys would need in-kernel masking to stay out of
     the softmax, so an unaligned key length raises instead of silently
     attending to padding. ``causal`` assumes S == Sk (self-attention).
-    Gradients flow via an XLA recompute backward.
+    Gradients flow through fused Pallas dQ and dK/dV kernels (the forward
+    saves the per-row logsumexp); the S×S matrix never reaches HBM in
+    either direction.
     """
     B, H, S, D = q.shape
     Sk = k.shape[2]

@@ -10,7 +10,16 @@ sharded over the ``pipe`` axis), and microbatch activations hop stages via
 ``lax.ppermute`` over ICI. ``M`` microbatches over ``N`` stages take
 ``M + N - 1`` ticks (the GPipe bubble); everything is a ``lax.scan`` so XLA
 sees one compiled loop, and the whole thing is differentiable (``ppermute``
-has a transpose rule) so ``jax.grad`` of a pipelined loss just works.
+has a transpose rule) so ``jax.grad`` of a pipelined loss just works —
+gradients accumulate across microbatches exactly like GPipe.
+
+Heterogeneous models (embed -> blocks -> logits/loss) fit the SPMD
+uniformity requirement through ``first_fn``/``last_fn``: the repeated
+``stage_fn`` maps a fixed "wire" activation shape to itself, while the
+first/last stages adapt raw inputs to the wire and the wire to outputs.
+Their (replicated) computations run on every device and are masked to
+the owning stage — the standard GPipe-under-SPMD trick: uniformity costs
+a little redundant embed/head compute, and buys one compiled program.
 """
 from __future__ import annotations
 
@@ -24,6 +33,9 @@ def stack_stage_params(per_stage_params):
 
     The result is what ``pipeline_apply`` expects: each leaf has shape
     ``(n_stages, ...)``; shard the leading axis over the pipe mesh axis.
+    All stages must share one parameter structure (equal blocks per
+    stage — the usual pipeline layout); adapters that don't fit it go in
+    ``first_fn``/``last_fn`` params instead.
     """
     import jax
     import jax.numpy as jnp
@@ -31,14 +43,16 @@ def stack_stage_params(per_stage_params):
         lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
 
-def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe"):
+def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
+                   first_fn=None, first_params=None,
+                   last_fn=None, last_params=None, remat=False):
     """Run ``N = mesh.shape[axis]`` pipeline stages over microbatched input.
 
     Parameters
     ----------
     stage_fn : callable(params_i, x) -> y
         The per-stage computation; ``y`` must have ``x``'s shape/dtype
-        (residual-block style), so activations can hop devices uniformly.
+        (the pipeline "wire"), so activations can hop devices uniformly.
     stage_params : pytree
         Per-stage parameters stacked on a leading ``n_stages`` axis
         (see ``stack_stage_params``).
@@ -46,13 +60,25 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe"):
         ``M`` microbatches. ``M >= N`` keeps the bubble fraction at
         ``(N-1)/(M+N-1)``.
     mesh : jax.sharding.Mesh with the ``axis`` dimension.
+    first_fn : callable(first_params, raw_mb) -> wire, optional
+        Input adapter owned by stage 0 (e.g. embedding lookup: int token
+        ids -> hidden states). Its output defines the wire shape/dtype.
+        ``first_params`` ride replicated.
+    last_fn : callable(last_params, wire) -> out, optional
+        Output head owned by stage N-1 (e.g. final norm + logits, or a
+        per-microbatch loss). Defines the returned shape.
+    remat : bool
+        Wrap ``stage_fn`` in ``jax.checkpoint`` so backward recomputes
+        stage activations per microbatch instead of storing all
+        ``M x N`` of them (GPipe's activation memory trade).
 
-    Returns the (M, mb, ...) outputs of the last stage.
+    Returns the (M, ...) per-microbatch outputs of ``last_fn`` (or of the
+    last stage when ``last_fn`` is None).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
     n_stages = mesh.shape[axis]
@@ -60,48 +86,64 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe"):
     if n_micro < 1:
         raise ValueError("need at least one microbatch")
 
-    # params: leading stage axis sharded over the pipe axis; inputs and
-    # outputs replicated (only stage 0 reads, only stage N-1 writes —
-    # jnp.where keeps the SPMD program uniform).
-    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
-    def spmd(params, xs):
+    # wire shape: what hops between devices each tick
+    if first_fn is None:
+        wire_sd = jax.eval_shape(lambda x: x[0], inputs)
+    else:
+        wire_sd = jax.eval_shape(first_fn, first_params,
+                                 jax.eval_shape(lambda x: x[0], inputs))
+    out_sd = wire_sd if last_fn is None else \
+        jax.eval_shape(last_fn, last_params, wire_sd)
+
+    # params: leading stage axis sharded over the pipe axis; inputs,
+    # outputs, and the first/last adapters replicated (only stage 0
+    # reads, only stage N-1 writes — jnp.where keeps SPMD uniform).
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+
+    def spmd(params, fparams, lparams, xs):
         idx = lax.axis_index(axis)
         # this device's stage params: shard_map hands us a leading axis of
         # size n_stages/n_stages == 1
         local = jax.tree_util.tree_map(lambda p: p[0], params)
-        mb_shape = xs.shape[1:]
         ticks = n_micro + n_stages - 1
 
         def step(carry, t):
             recv, outs = carry
-            x = jnp.where(idx == 0,
-                          xs[jnp.clip(t, 0, n_micro - 1)], recv)
+            raw = xs[jnp.clip(t, 0, n_micro - 1)]
+            z0 = raw if first_fn is None else first_fn(fparams, raw)
+            x = jnp.where(idx == 0, z0, recv)
             y = stage_fn(local, x)
             # device i hands its activation to i+1 (the last stage's
             # output stays home and is collected below)
             send = lax.ppermute(
                 y, axis, perm=[(i, i + 1) for i in range(n_stages - 1)])
+            out = y if last_fn is None else last_fn(lparams, y)
             out_t = t - (n_stages - 1)
             take = jnp.logical_and(idx == n_stages - 1,
                                    jnp.logical_and(out_t >= 0,
                                                    out_t < n_micro))
+            slot = jnp.clip(out_t, 0, n_micro - 1)
             outs = lax.dynamic_update_index_in_dim(
                 outs,
-                jnp.where(take, y, lax.dynamic_index_in_dim(
-                    outs, jnp.clip(out_t, 0, n_micro - 1), 0,
-                    keepdims=False)),
-                jnp.clip(out_t, 0, n_micro - 1), 0)
+                jnp.where(take, out, lax.dynamic_index_in_dim(
+                    outs, slot, 0, keepdims=False)),
+                slot, 0)
             return (send, outs), None
 
-        init = (jnp.zeros(mb_shape, inputs.dtype),
-                jnp.zeros((n_micro,) + mb_shape, inputs.dtype))
+        init = (jnp.zeros(wire_sd.shape, wire_sd.dtype),
+                jnp.zeros((n_micro,) + out_sd.shape, out_sd.dtype))
         (_, outs), _ = lax.scan(step, init, jnp.arange(ticks))
         # everyone returns; only the last stage's buffer is real —
         # psum after masking replicates it across the pipe axis
         outs = jnp.where(idx == n_stages - 1, outs, 0)
         return lax.psum(outs, axis)
 
-    fn = shard_map(spmd, mesh=mesh, in_specs=(param_spec, P()),
+    fn = shard_map(spmd, mesh=mesh,
+                   in_specs=(param_spec, rep(first_params),
+                             rep(last_params), P()),
                    out_specs=P(), check_rep=False)
-    return fn(stage_params, inputs)
+    return fn(stage_params, first_params, last_params, inputs)

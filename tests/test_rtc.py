@@ -172,3 +172,40 @@ def test_flash_attention_rejects_unaligned_keys():
     with pytest.raises(ValueError, match="multiple of block_k"):
         from mxnet_tpu.ops.pallas import flash_attention
         flash_attention(q, k, k, block_k=64, interpret=True)
+
+
+def test_flash_attention_fused_bwd_cross_and_bf16():
+    # fused Pallas backward: rectangular (Sk != S) grads match XLA, and the
+    # bf16 path stays within bf16 tolerance of the f32 oracle
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import flash_attention
+    rng = np.random.RandomState(11)
+    B, H, S, Sk, D = 1, 2, 64, 128, 16
+    cpu = jax.local_devices(backend="cpu")[0]
+    q = jax.device_put(rng.randn(B, H, S, D).astype(np.float32), cpu)
+    k = jax.device_put(rng.randn(B, H, Sk, D).astype(np.float32), cpu)
+    v = jax.device_put(rng.randn(B, H, Sk, D).astype(np.float32), cpu)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, block_q=32, block_k=32,
+                               interpret=True).sum()
+
+    def f_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        p = jax.nn.softmax(s, -1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    g16 = jax.grad(lambda *a: f_flash(*a).astype(jnp.float32),
+                   argnums=(0, 1, 2))(qb, kb, vb)
+    for a, b in zip(g16, g_ref):
+        err = np.max(np.abs(np.asarray(a, np.float32) - np.asarray(b)))
+        scale = np.max(np.abs(np.asarray(b))) + 1e-6
+        assert err / scale < 0.06, err / scale
