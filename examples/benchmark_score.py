@@ -21,7 +21,7 @@ import numpy as np
 
 def get_symbol(network):
     import mxnet_tpu as mx
-    from mxnet_tpu.models import alexnet, lenet, mlp, resnet, vgg
+    from mxnet_tpu.models import alexnet, inception, lenet, mlp, resnet, vgg
     if network.startswith("resnet-"):
         return resnet.get_symbol(num_classes=1000,
                                  num_layers=int(network.split("-")[1])), 224
@@ -30,13 +30,20 @@ def get_symbol(network):
                               num_layers=int(network.split("-")[1])), 224
     if network == "alexnet":
         return alexnet.get_symbol(num_classes=1000), 224
+    if network == "inception-v3":
+        return inception.get_symbol(num_classes=1000, version="v3"), 299
+    if network == "inception-bn":
+        return inception.get_symbol(num_classes=1000, version="bn"), 224
     if network == "lenet":
         return lenet.get_symbol(num_classes=10), 28
     raise ValueError("unknown network %r" % network)
 
 
-def score(network, batch_size, ctx, iters=20, warmup=3):
-    """img/s for one (network, batch) — the reference's score() shape."""
+def score(network, batch_size, ctx, iters=20, warmup=3, train=False):
+    """img/s for one (network, batch) — the reference's score() shape.
+
+    ``train=True`` times the fused fwd+bwd+SGD-update step instead (the
+    reference's training table uses train_imagenet.py; same workload)."""
     import mxnet_tpu as mx
     sym, size = get_symbol(network)
     channels = 1 if network == "lenet" else 3
@@ -45,22 +52,42 @@ def score(network, batch_size, ctx, iters=20, warmup=3):
     # softmax ignores it — same situation Predictor zero-fills)
     mod.bind(data_shapes=[("data", (batch_size, channels, size, size))],
              label_shapes=[("softmax_label", (batch_size,))],
-             for_training=False)
+             for_training=train)
     mod.init_params(mx.init.Xavier(magnitude=2))
     rng = np.random.RandomState(0)
-    batch = mx.io.DataBatch(data=[mx.nd.array(
-        rng.uniform(-1, 1, (batch_size, channels, size, size))
-        .astype(np.float32), ctx=ctx)])
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(
+            rng.uniform(-1, 1, (batch_size, channels, size, size))
+            .astype(np.float32), ctx=ctx)],
+        label=[mx.nd.array(
+            rng.randint(0, 1000, (batch_size,)).astype(np.float32),
+            ctx=ctx)])
 
-    def drain():
-        return float(mod.get_outputs()[0].asnumpy().ravel()[0])
+    if train:
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01,
+                                             "momentum": 0.9})
+        first_param = sorted(mod._exec.arg_dict)[0]
+
+        def run_once():
+            mod._fit_step(batch)
+
+        def drain():
+            return float(np.asarray(
+                mod._exec.arg_dict[first_param].data.ravel()[0]))
+    else:
+        def run_once():
+            mod.forward(batch, is_train=False)
+
+        def drain():
+            return float(mod.get_outputs()[0].asnumpy().ravel()[0])
 
     for _ in range(warmup):
-        mod.forward(batch, is_train=False)
+        run_once()
     drain()
     t0 = time.perf_counter()
     for _ in range(iters):
-        mod.forward(batch, is_train=False)
+        run_once()
     drain()
     dt = time.perf_counter() - t0
     return batch_size * iters / dt
@@ -75,6 +102,8 @@ def main():
     parser.add_argument("--iters", type=int, default=20)
     parser.add_argument("--bf16", action="store_true",
                         help="mixed-precision inference (mx.amp)")
+    parser.add_argument("--train", action="store_true",
+                        help="time the fused train step instead of forward")
     args = parser.parse_args()
 
     import mxnet_tpu as mx
@@ -82,12 +111,15 @@ def main():
         mx.amp.init("bfloat16")
     ctx = mx.tpu(0) if mx.num_devices("tpu") else mx.cpu(0)
     print("context:", ctx)
-    nets = (["alexnet", "vgg-16", "resnet-50", "resnet-152"]
+    nets = (["alexnet", "vgg-16", "inception-bn", "inception-v3",
+             "resnet-50", "resnet-152"]
             if args.network == "all" else [args.network])
     for net in nets:
         for bs in [int(b) for b in args.batch_sizes.split(",")]:
-            img_s = score(net, bs, ctx, iters=args.iters)
-            print("network: %-12s batch: %-4d  %.1f img/s" % (net, bs, img_s))
+            img_s = score(net, bs, ctx, iters=args.iters, train=args.train)
+            print("network: %-12s batch: %-4d  %.1f img/s%s"
+                  % (net, bs, img_s, " (train)" if args.train else ""),
+                  flush=True)
     return 0
 
 

@@ -1,0 +1,114 @@
+"""Pipeline-parallel MoE transformer LM: PipelineModule + sym.MoE on a
+device mesh.
+
+The modern-parallelism showcase CLI: a decoder-only transformer split
+into GPipe pipeline stages over a ``pipe`` mesh axis (embedding adapter,
+N body stages of attention + mixture-of-experts FFN blocks, loss head),
+trained with microbatch gradient accumulation — the TPU-native
+first-class version of the reference's hand-placed inter-layer model
+parallelism (``example/model-parallel-lstm/lstm.py:65-129`` +
+``group2ctx``, src/executor/graph_executor.cc:279-393).
+
+The task is next-token prediction on a deterministic cyclic corpus, so
+falling perplexity proves the pipelined gradients are real.
+
+Run (8 virtual devices):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/pipeline_moe_transformer.py --stages 4 --experts 4
+"""
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+VOCAB = 16
+
+
+def synth_batches(batch, seq_len, n_batches, seed=0):
+    """Cyclic 0..9 token stream with noise tokens 10..15; the cycle makes
+    next-token prediction learnable to low perplexity."""
+    rng = np.random.RandomState(seed)
+    toks = []
+    while len(toks) < batch * (seq_len + 1) * n_batches:
+        toks.extend(range(10))
+        if rng.rand() < 0.3:
+            toks.append(10 + rng.randint(6))
+    toks = np.asarray(toks, np.int32)
+    out = []
+    per = batch * (seq_len + 1)
+    for i in range(n_batches):
+        seg = toks[i * per:(i + 1) * per].reshape(batch, seq_len + 1)
+        out.append((seg[:, :-1].astype(np.float32),
+                    seg[:, 1:].astype(np.float32)))
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description="pipelined MoE transformer LM")
+    p.add_argument("--stages", type=int, default=4,
+                   help="pipeline body stages (devices on the pipe axis)")
+    p.add_argument("--layers-per-stage", type=int, default=1)
+    p.add_argument("--experts", type=int, default=4,
+                   help="MoE experts per block (0 = dense FFN)")
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--microbatches", type=int, default=8)
+    p.add_argument("--num-batches", type=int, default=30)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.02)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import transformer
+
+    stages = transformer.get_pipeline_stages(
+        vocab_size=VOCAB, n_stages=args.stages,
+        layers_per_stage=args.layers_per_stage, d_model=args.d_model,
+        n_heads=args.n_heads, seq_len=args.seq_len,
+        moe_experts=args.experts)
+    mod = mx.mod.PipelineModule(stages, n_microbatches=args.microbatches)
+    mod.bind(data_shapes=[("data", (args.batch_size, args.seq_len))],
+             label_shapes=[("softmax_label",
+                            (args.batch_size, args.seq_len))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9,
+                                         "clip_gradient": 1.0})
+
+    batches = synth_batches(args.batch_size, args.seq_len,
+                            args.num_batches)
+    first_ppl = last_ppl = None
+    for epoch in range(args.num_epochs):
+        tic = time.time()
+        tot_nll = tot_tok = 0.0
+        for x, y in batches:
+            db = mx.io.DataBatch(data=[mx.nd.array(x)],
+                                 label=[mx.nd.array(y)])
+            outs = mod.fit_step(db)           # (M, mb*T, V) probs
+            probs = np.asarray(outs).reshape(-1, VOCAB)
+            labels = y.reshape(-1).astype(int)
+            pick = np.maximum(probs[np.arange(labels.size), labels], 1e-9)
+            tot_nll += -np.log(pick).sum()
+            tot_tok += labels.size
+        ppl = math.exp(tot_nll / tot_tok)
+        if first_ppl is None:
+            first_ppl = ppl
+        last_ppl = ppl
+        print("Epoch[%d] ppl=%.2f (%.1fs)" % (epoch, ppl,
+                                              time.time() - tic),
+              flush=True)
+    print("final-ppl=%.3f uniform=%.1f" % (last_ppl, VOCAB))
+    assert last_ppl < first_ppl, "pipelined training did not learn"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

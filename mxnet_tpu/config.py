@@ -87,6 +87,9 @@ register("MXNET_PROFILER_AUTOSTART", _parse_bool, False,
          "start mx.profiler at import")
 register("MXNET_KVSTORE_HEARTBEAT_STALE_SECS", float, 20.0,
          "heartbeat staleness threshold for get_num_dead_node")
+register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+         "elements per fused-allreduce chunk in the dist kvstore "
+         "(reference: big-array server sharding, kvstore_dist.h:292)")
 register("MXNET_USE_NATIVE_IO", _parse_bool, True,
          "use the C++ data path (libmxnative: RecordIO codec, jpeg/png "
          "decode, threaded augment pipeline); 0 = pure-Python/cv2 path")

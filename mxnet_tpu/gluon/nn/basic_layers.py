@@ -13,7 +13,7 @@ from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Activation",
            "Dropout", "BatchNorm", "LeakyReLU", "Embedding", "Flatten",
-           "Lambda", "HybridLambda"]
+           "Lambda", "HybridLambda", "MoE", "collect_aux_losses"]
 
 
 class Sequential(Block):
@@ -268,3 +268,76 @@ class HybridLambda(HybridBlock):
 
     def hybrid_forward(self, F, *args):
         return self._func(F, *args)
+
+
+class MoE(HybridBlock):
+    """Mixture-of-experts FFN layer (Switch/GShard dense dispatch).
+
+    No reference counterpart (SURVEY.md §2.21: expert parallel absent
+    upstream) — this is the TPU build's modern block over the ``MoE``
+    framework op (ops/contrib.py / parallel/moe.py). Input (..., d_model)
+    -> output of the same shape.
+
+    The router's load-balance auxiliary loss from the latest forward is
+    kept on ``self.aux_loss``; add ``collect_aux_losses(net)`` (weighted)
+    to the training loss so the router learns balanced routing::
+
+        out = net(x)
+        loss = loss_fn(out, y) + 0.01 * nn.collect_aux_losses(net)
+
+    Keep MoE nets *unhybridized* when training the router: under
+    ``hybridize()`` the forward runs once inside a jit trace, so the
+    stashed aux loss would be a stale tracer — ``collect_aux_losses``
+    detects that and raises instead of silently untraining the router.
+    """
+
+    def __init__(self, d_model, d_hidden, n_experts, top_k=2,
+                 capacity_factor=1.25, weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        from ... import initializer as init_mod
+        self._attrs = dict(top_k=int(top_k),
+                           capacity_factor=float(capacity_factor))
+        self.aux_loss = None
+        s_in = 1.0 / float(d_model) ** 0.5
+        s_hid = 1.0 / float(d_hidden) ** 0.5
+        with self.name_scope():
+            self.router = self.params.get(
+                "router_weight", shape=(d_model, n_experts),
+                init=weight_initializer or init_mod.Normal(s_in))
+            self.wi = self.params.get(
+                "wi_weight", shape=(n_experts, d_model, d_hidden),
+                init=weight_initializer or init_mod.Normal(s_in))
+            self.wo = self.params.get(
+                "wo_weight", shape=(n_experts, d_hidden, d_model),
+                init=weight_initializer or init_mod.Normal(s_hid))
+
+    def hybrid_forward(self, F, x, router, wi, wo):
+        out, aux = F.MoE(x, router, wi, wo, **self._attrs)
+        self.aux_loss = aux
+        return out
+
+
+def collect_aux_losses(block):
+    """Sum the ``aux_loss`` of every sub-block that produced one in its
+    latest forward (e.g. :class:`MoE` routers). Returns 0.0 when none.
+
+    Raises when an aux loss was captured inside a ``hybridize()`` jit
+    trace (a stale tracer that cannot participate in a later loss);
+    aux-loss training is an eager-path feature."""
+    import jax.core as _jcore
+    total = None
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        aux = getattr(b, "aux_loss", None)
+        if aux is not None:
+            data = getattr(aux, "data", aux)
+            if isinstance(data, _jcore.Tracer):
+                raise RuntimeError(
+                    "%s.aux_loss was captured inside a hybridize() trace "
+                    "and is stale; run the net unhybridized "
+                    "(net.hybridize(False)) to train with auxiliary "
+                    "losses" % type(b).__name__)
+            total = aux if total is None else total + aux
+        stack.extend(getattr(b, "_children", ()))
+    return 0.0 if total is None else total

@@ -88,17 +88,26 @@ class Trainer(object):
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
 
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            if self._kvstore is not None:
-                grad = param.grad()
-                self._kvstore.push(i, grad)
+        live = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if self._kvstore is not None:
+            # all pushes before any pull: pushes are asynchronous
+            # (reference ZPush), and the dist kvstore fuses every staged
+            # key into one allreduce at the first pull — per-key RPC
+            # round trips collapse into one per step
+            for i in live:
+                self._kvstore.push(i, self._params[i].grad())
+            for i in live:
+                param = self._params[i]
                 if self._update_on_kvstore:
                     self._kvstore.pull(i, out=param.data())
-                    continue
-                self._kvstore.pull(i, out=grad)
-            self._updaters(i, param.grad(), param.data())
+                else:
+                    self._kvstore.pull(i, out=param.grad())
+                    self._updaters(i, param.grad(), param.data())
+        else:
+            for i in live:
+                self._updaters(i, self._params[i].grad(),
+                               self._params[i].data())
 
     def save_states(self, fname):
         """(reference: trainer.py save_states)."""

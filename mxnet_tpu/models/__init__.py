@@ -9,6 +9,7 @@ from . import mlp
 from . import lenet
 from . import alexnet
 from . import vgg
+from . import inception
 from .resnet import get_symbol as get_resnet
 
-__all__ = ["resnet", "mlp", "lenet", "alexnet", "vgg", "get_resnet"]
+__all__ = ["resnet", "mlp", "lenet", "alexnet", "vgg", "inception", "get_resnet"]

@@ -15,7 +15,7 @@ import numpy as np
 
 from .. import symbol as sym
 
-__all__ = ["get_symbol", "param_count"]
+__all__ = ["get_symbol", "get_pipeline_stages", "param_count"]
 
 
 def _attention(x, n_heads, d_model, T, name, attention="dense"):
@@ -105,6 +105,76 @@ def get_symbol(vocab_size=32000, num_layers=12, d_model=768, n_heads=12,
     label = sym.reshape(sym.Variable("softmax_label"), (-1,))
     return sym.SoftmaxOutput(logits, label, name="softmax",
                              normalization="batch")
+
+
+def get_pipeline_stages(vocab_size=32000, n_stages=2, layers_per_stage=1,
+                        d_model=256, n_heads=4, seq_len=128, d_ff=None,
+                        moe_experts=0, moe_top_k=2, attention="dense"):
+    """Stage symbols for ``mx.mod.PipelineModule``: [embed, body*, head].
+
+    Each body stage holds ``layers_per_stage`` transformer blocks; with
+    ``moe_experts > 0`` every block's FFN is a Switch/GShard MoE
+    (``sym.MoE``; the router's aux loss is computed per block but not
+    added to the pipelined objective — plumb it via the gluon
+    ``nn.MoE`` + ``collect_aux_losses`` path when router balance
+    matters). The head applies the final LayerNorm + lm head +
+    per-token SoftmaxOutput, so gradients follow Module.fit's loss-op
+    semantics per microbatch.
+    """
+    d_ff = d_ff or 4 * d_model
+    T = seq_len
+
+    data = sym.Variable("data")
+    tok = sym.Embedding(data, sym.Variable("tok_embed_weight"),
+                        input_dim=vocab_size, output_dim=d_model,
+                        name="tok_embed")
+    pos_ids = sym.arange(start=0, stop=T)
+    pos = sym.Embedding(pos_ids, sym.Variable("pos_embed_weight"),
+                        input_dim=T, output_dim=d_model, name="pos_embed")
+    embed = sym.broadcast_add(tok, sym.reshape(pos, (1, T, d_model)))
+
+    def body_stage(si):
+        x = sym.Variable("x")
+        for li in range(layers_per_stage):
+            name = "s%d_layer%d" % (si, li)
+            ln1 = sym.LayerNorm(x, sym.Variable("%s_ln1_gamma" % name),
+                                sym.Variable("%s_ln1_beta" % name))
+            x = x + _attention(ln1, n_heads, d_model, T, name + "_att",
+                               attention=attention)
+            ln2 = sym.LayerNorm(x, sym.Variable("%s_ln2_gamma" % name),
+                                sym.Variable("%s_ln2_beta" % name))
+            if moe_experts:
+                # expert count isn't derivable from activation shapes, so
+                # the MoE variables carry explicit shape hints
+                h = sym.MoE(ln2,
+                            sym.Variable("%s_moe_router_weight" % name,
+                                         shape=(d_model, moe_experts)),
+                            sym.Variable("%s_moe_wi_weight" % name,
+                                         shape=(moe_experts, d_model,
+                                                d_ff)),
+                            sym.Variable("%s_moe_wo_weight" % name,
+                                         shape=(moe_experts, d_ff,
+                                                d_model)),
+                            top_k=moe_top_k)[0]
+            else:
+                h = sym.FullyConnected(ln2, num_hidden=d_ff, flatten=False,
+                                       name="%s_ff1" % name)
+                h = sym.Activation(h, act_type="relu")
+                h = sym.FullyConnected(h, num_hidden=d_model,
+                                       flatten=False, name="%s_ff2" % name)
+            x = x + h
+        return x
+
+    x = sym.Variable("x")
+    x = sym.LayerNorm(x, sym.Variable("final_ln_gamma"),
+                      sym.Variable("final_ln_beta"))
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
+                                name="lm_head")
+    logits = sym.reshape(logits, (-1, vocab_size))
+    label = sym.reshape(sym.Variable("softmax_label"), (-1,))
+    head = sym.SoftmaxOutput(logits, label, name="softmax",
+                             normalization="batch")
+    return [embed] + [body_stage(i) for i in range(n_stages)] + [head]
 
 
 def param_count(vocab_size=32000, num_layers=12, d_model=768, n_heads=12,

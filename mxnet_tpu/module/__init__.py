@@ -16,5 +16,7 @@ from .base_module import BaseModule
 from .module import Module
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
+from .pipeline_module import PipelineModule
 
-__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule"]
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule",
+           "PipelineModule"]

@@ -1,0 +1,344 @@
+"""PipelineModule: Module-style training with GPipe pipeline stages.
+
+The user surface for pipeline parallelism (the reference's inter-layer
+``group2ctx`` story, src/executor/graph_executor.cc:279-393, made a
+first-class schedule): the model arrives as a list of stage Symbols, one
+per device along a ``pipe`` mesh axis, and the whole schedule — embed
+adapter, N repeated stages, loss head, microbatch accumulation, backward,
+optimizer update — compiles into ONE jitted SPMD program built on
+``parallel.pipeline_apply``.
+
+Stage contract (shapes inferred at ``bind``):
+
+* ``stages[0]`` — input adapter: consumes the ``data`` variable, emits
+  the pipeline "wire" (e.g. token embedding). Runs replicated.
+* ``stages[1:-1]`` — the repeated body: one free variable named ``x``
+  (the wire), wire-shaped output, and **identical parameter structure**
+  across stages (equal blocks per stage, the usual pipeline layout);
+  their stacked parameters are sharded over the pipe axis.
+* ``stages[-1]`` — the head: free variable ``x`` plus any bound label
+  variables (e.g. ``softmax_label``); typically ends in a loss op
+  (SoftmaxOutput). Runs replicated. Its output is treated like Module's
+  forward outputs: backward seeds it with ones, so loss ops' non-vjp
+  backward semantics (p - onehot) apply per microbatch and gradients
+  accumulate across microbatches — GPipe gradient accumulation.
+
+Limitations (v1): no auxiliary states inside stages (BatchNorm — use
+LayerNorm, the pipeline-era norm anyway) and the per-step RNG key is
+shared across microbatches (affects Dropout only).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd_mod
+from .. import optimizer as opt_mod
+from ..executor import graph_function
+from ..parallel.mesh import make_mesh
+from ..parallel.pipeline import pipeline_apply, stack_stage_params
+
+__all__ = ["PipelineModule"]
+
+
+class PipelineModule(object):
+    """Train a stage-split model with a GPipe schedule over a pipe axis.
+
+    Parameters
+    ----------
+    stages : list of Symbol
+        See the module docstring for the stage contract.
+    n_microbatches : int
+        The bound batch is split into this many microbatches; must divide
+        the batch size. More microbatches shrink the pipeline bubble.
+    mesh : jax.sharding.Mesh, optional
+        Must contain ``axis``; default is a fresh 1-D mesh over all
+        devices.
+    axis : str
+        Pipe mesh-axis name.
+    remat : bool
+        Recompute stage activations in backward (GPipe memory trade).
+    """
+
+    def __init__(self, stages, n_microbatches, mesh=None, axis="pipe",
+                 remat=False, logger=logging):
+        if len(stages) < 3:
+            raise ValueError("need >= 3 stages (adapter, body..., head)")
+        self._stages = list(stages)
+        self._n_micro = int(n_microbatches)
+        self._axis = axis
+        self._remat = bool(remat)
+        self._mesh = mesh
+        self.logger = logger
+        self._bound = False
+        self._params: Dict[str, Dict[str, object]] = {}
+        self._optimizer = None
+        self._step_fn = None
+
+    # ------------------------------------------------------------- bind
+
+    def bind(self, data_shapes, label_shapes=None, **_):
+        import jax
+
+        n_body = len(self._stages) - 2
+        if self._mesh is None:
+            self._mesh = make_mesh({self._axis: n_body})
+        if self._mesh.shape[self._axis] != n_body:
+            raise ValueError(
+                "mesh axis %r has %d devices but there are %d body stages"
+                % (self._axis, self._mesh.shape[self._axis], n_body))
+
+        self._data_name, data_shape = data_shapes[0][0], data_shapes[0][1]
+        self._label_name = label_shapes[0][0] if label_shapes else None
+        label_shape = label_shapes[0][1] if label_shapes else None
+        B = data_shape[0]
+        if B % self._n_micro:
+            raise ValueError("batch %d not divisible by %d microbatches"
+                             % (B, self._n_micro))
+        mb = B // self._n_micro
+        self._batch = B
+        mb_data = (mb,) + tuple(data_shape[1:])
+        mb_label = (mb,) + tuple(label_shape[1:]) if label_shape else None
+
+        # per-stage shape inference walks the wire through the stages
+        self._stage_args: List[Dict[str, tuple]] = []
+        for i, sym in enumerate(self._stages):
+            if sym.list_auxiliary_states():
+                raise MXNetError(
+                    "PipelineModule stages cannot hold auxiliary states "
+                    "(stage %d has %s)" % (i, sym.list_auxiliary_states()))
+            feed = {}
+            if i == 0:
+                feed[self._data_name] = mb_data
+            else:
+                feed["x"] = self._wire_shape
+            if i == len(self._stages) - 1 and self._label_name and \
+                    self._label_name in sym.list_arguments():
+                feed[self._label_name] = mb_label
+            arg_shapes, out_shapes, _ = sym.infer_shape(**feed)
+            args = {n: tuple(s) for n, s in
+                    zip(sym.list_arguments(), arg_shapes)
+                    if n not in feed}
+            self._stage_args.append(args)
+            if i < len(self._stages) - 1:
+                self._wire_shape = tuple(out_shapes[0])
+            else:
+                self._out_shape = tuple(out_shapes[0])
+
+        # body stages may use per-stage names (b1_*, b2_*, ...): they are
+        # matched positionally in sorted-name order against stage 1, and
+        # their stacked pytree is keyed by stage 1's names (the body fn)
+        body = self._stage_args[1:-1]
+        canon = sorted(body[0])
+        self._body_order = [sorted(b) for b in body]
+        for i, names in enumerate(self._body_order):
+            shapes = [body[i][n] for n in names]
+            want = [body[0][n] for n in canon]
+            if shapes != want:
+                raise ValueError(
+                    "body stage %d parameter shapes %s do not line up "
+                    "with stage 1's %s" % (i + 1, shapes, want))
+
+        self._fns = [graph_function(s) for s in self._stages]
+        self._bound = True
+        return self
+
+    # ----------------------------------------------------------- params
+
+    def init_params(self, initializer=None, force_init=False):
+        from .. import initializer as init_mod
+        initializer = initializer or init_mod.Uniform(0.01)
+        if self._params and not force_init:
+            return
+        for i, args in enumerate(self._stage_args):
+            stage_params = {}
+            for name, shape in args.items():
+                arr = nd_mod.zeros(shape, dtype=np.float32)
+                initializer(init_mod.InitDesc(name, {}), arr)
+                stage_params[name] = np.asarray(arr.asnumpy())
+            self._params[i] = stage_params
+
+    def get_params(self):
+        """Per-stage parameter dicts, reflecting training: after
+        init_optimizer the authoritative copies live on device
+        (fit_step's donated jit updates them), so read those back."""
+        if getattr(self, "_dev_params", None) is None:
+            return {i: dict(p) for i, p in self._params.items()}
+        n_stage = len(self._stages)
+        out = {0: {k: np.asarray(v)
+                   for k, v in self._dev_params["first"].items()}}
+        canon = sorted(self._stage_args[1])
+        for i in range(1, n_stage - 1):
+            names = self._body_order[i - 1]
+            out[i] = {n: np.asarray(self._dev_params["body"][c][i - 1])
+                      for c, n in zip(canon, names)}
+        out[n_stage - 1] = {k: np.asarray(v)
+                            for k, v in self._dev_params["last"].items()}
+        return out
+
+    # -------------------------------------------------------- optimizer
+
+    def init_optimizer(self, optimizer="sgd", optimizer_params=None):
+        import jax
+        import jax.numpy as jnp
+
+        if not self._bound:
+            raise MXNetError("bind before init_optimizer")
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params or {})
+            # per-example gradient scaling, same convention as
+            # Module.init_optimizer (module.py:345-351): head grads are
+            # p-onehot per microbatch, summed over microbatches
+            optimizer_params.setdefault("rescale_grad", 1.0 / self._batch)
+            optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer = optimizer
+
+        fns = self._fns
+        n_stage = len(self._stages)
+        data_name, label_name = self._data_name, self._label_name
+        mesh, axis, n_micro = self._mesh, self._axis, self._n_micro
+        remat = self._remat
+
+        def run_sym(fn, extra):
+            def call(params, key):
+                outs, _ = fn({**params, **extra}, {}, key, True)
+                return outs[0]
+            return call
+
+        def first_fn(p, raw):
+            outs, _ = fns[0]({**p, data_name: raw[data_name]}, {},
+                             p["__key__"], True)
+            return outs[0]
+
+        def stage_fn(p, x):
+            outs, _ = fns[1]({**{k: v for k, v in p.items()
+                                 if k != "__key__"}, "x": x}, {},
+                             p["__key__"], True)
+            return outs[0]
+
+        def last_fn(p, y, raw):
+            feed = {k: v for k, v in p.items() if k != "__key__"}
+            feed["x"] = y
+            if label_name is not None:
+                feed[label_name] = raw[label_name]
+            outs, _ = fns[n_stage - 1](feed, {}, p["__key__"], True)
+            return outs[0]
+
+        def loss_like(params, inputs, key):
+            fp = dict(params["first"]); fp["__key__"] = key
+            lp = dict(params["last"]); lp["__key__"] = key
+            sp = dict(params["body"]); sp["__key__"] = \
+                jnp.broadcast_to(key, (n_stage - 2,) + key.shape)
+            outs = pipeline_apply(
+                stage_fn, sp, inputs, mesh=mesh, axis=axis,
+                first_fn=first_fn, first_params=fp,
+                last_fn=last_fn, last_params=lp, remat=remat)
+            return jnp.sum(outs.astype(jnp.float32)), outs
+
+        opt = self._optimizer
+
+        def step(params, states, inputs, key, lr, t):
+            grads, outs = jax.grad(loss_like, has_aux=True)(
+                params, inputs, key)
+            new_p, new_s = {}, {}
+            idx = 0
+            for grp in ("first", "body", "last"):
+                gp, gs = {}, {}
+                for name in sorted(params[grp]):
+                    w, s = opt.raw_update(
+                        idx, params[grp][name], grads[grp][name],
+                        states[grp][name], lr=lr, t=t)
+                    gp[name], gs[name] = w, s
+                    idx += 1
+                new_p[grp], new_s[grp] = gp, gs
+            return outs, new_p, new_s
+
+        self._step_jit = jax.jit(step, donate_argnums=(0, 1))
+
+        # assemble device param pytrees: body stacked under stage 1's
+        # names (positional match in sorted order), first/last flat
+        import jax.numpy as jnp
+        canon = sorted(self._stage_args[1])
+        body_trees = []
+        for i in range(1, n_stage - 1):
+            names = self._body_order[i - 1]
+            body_trees.append({c: jnp.asarray(self._params[i][n])
+                               for c, n in zip(canon, names)})
+        self._dev_params = {
+            "first": {k: jnp.asarray(v)
+                      for k, v in self._params[0].items()},
+            "body": stack_stage_params(body_trees),
+            "last": {k: jnp.asarray(v)
+                     for k, v in self._params[n_stage - 1].items()},
+        }
+
+        # optimizer state per leaf (momentum etc.); SGD w/o momentum -> None
+        def state_for(w):
+            s = opt.create_state(0, nd_mod.array(np.zeros(w.shape,
+                                                          np.float32)))
+            return jax.tree_util.tree_map(
+                lambda x: jnp.zeros(w.shape, jnp.float32)
+                if hasattr(x, "shape") else x, s,
+                is_leaf=lambda x: x is None or hasattr(x, "shape"))
+
+        self._dev_states = jax.tree_util.tree_map(
+            state_for, self._dev_params,
+            is_leaf=lambda x: hasattr(x, "shape"))
+        self._t = 0
+
+    # ------------------------------------------------------------- step
+
+    def fit_step(self, data_batch):
+        """One pipelined train step; returns the head outputs
+        (n_microbatches, mb, ...)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._optimizer is None:
+            raise MXNetError("init_optimizer before fit_step")
+        B = self._batch
+        M = self._n_micro
+        x = np.asarray(data_batch.data[0].asnumpy())
+        inputs = {self._data_name:
+                  jnp.asarray(x.reshape((M, B // M) + x.shape[1:]))}
+        if self._label_name is not None:
+            y = np.asarray(data_batch.label[0].asnumpy())
+            inputs[self._label_name] = jnp.asarray(
+                y.reshape((M, B // M) + y.shape[1:]))
+        key = jax.random.PRNGKey(self._t)
+        # Module's fused-step lr convention (module.py:530-537):
+        # advance num_update and honor the lr scheduler
+        self._t += 1
+        self._optimizer.num_update = self._t
+        if getattr(self._optimizer, "lr_scheduler", None) is not None:
+            lr = self._optimizer.lr_scheduler(self._t)
+        else:
+            lr = self._optimizer.lr
+        outs, self._dev_params, self._dev_states = self._step_jit(
+            self._dev_params, self._dev_states, inputs, key,
+            jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._t, jnp.int32))
+        return outs
+
+    def fit(self, train_iter, num_epoch=1, eval_metric=None):
+        """Minimal fit loop: fit_step per batch (metric optional)."""
+        from .. import metric as metric_mod
+        if eval_metric is not None and not hasattr(eval_metric, "update"):
+            eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(num_epoch):
+            train_iter.reset()
+            for batch in train_iter:
+                outs = self.fit_step(batch)
+                if eval_metric is not None:
+                    # (M,) + per-microbatch head shape -> flatten the
+                    # microbatch axis into the leading row axis
+                    flat = nd_mod.array(np.asarray(outs).reshape(
+                        (-1,) + self._out_shape[1:]))
+                    eval_metric.update(batch.label, [flat])
+            if eval_metric is not None:
+                self.logger.info("Epoch[%d] %s", epoch,
+                                 eval_metric.get())
+        return self

@@ -106,3 +106,29 @@ def ifft(data, compute_size=128):
     c = x[..., 0] + 1j * x[..., 1]
     out = jnp.fft.ifft(c, axis=-1) * n
     return out.real.astype(data.dtype)
+
+
+@register("MoE", num_inputs=4, aliases=("_contrib_MoE",))
+def moe(data, router, wi, wo, top_k=2, capacity_factor=1.25):
+    """Mixture-of-experts FFN over tokens (no reference counterpart —
+    SURVEY.md §2.21 marks expert parallel absent upstream; this exposes
+    parallel/moe.py's Switch/GShard dense-dispatch MoE as a framework op
+    so nd/sym/gluon callers get it like any other layer).
+
+    data: (..., d_model) tokens (leading axes flattened for routing),
+    router: (d_model, E), wi: (E, d_model, d_hidden), wo: (E, d_hidden,
+    d_model). Returns (out, aux_loss): out matches data's shape; aux is
+    the scalar GShard load-balance loss. To shard experts over a mesh
+    axis, use ``parallel.moe_apply(mesh=...)`` directly or bind the
+    module with expert-sharded param_shardings.
+    """
+    from ..parallel.moe import moe_apply
+    lead = data.shape[:-1]
+    toks = data.reshape(-1, data.shape[-1])
+    out, aux = moe_apply({"router": router, "wi": wi, "wo": wo}, toks,
+                         top_k=int(top_k),
+                         capacity_factor=float(capacity_factor))
+    return out.reshape(lead + (data.shape[-1],)), aux
+
+
+moe.num_outputs = 2

@@ -405,14 +405,25 @@ def l2_normalization(data, eps=1e-10, mode="instance"):
 @register("LRN")
 def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
     """Local response norm across channels (reference: src/operator/lrn.cc).
-    Implemented as an avg-pool over the channel axis — one reduce_window."""
-    sq = jnp.square(data)
+    Implemented as an avg-pool over the channel axis — one reduce_window.
+
+    Computed in f32 with the channel window as explicit shifted-slice
+    adds rather than ``lax.reduce_window``: the windowed-reduce form
+    miscompiles on the TPU AOT compiler (post-optimization "incompatible
+    shapes [...,96] vs [...,92]" internal error, seen on AlexNet batch 1
+    in both f32 and bf16); nsize is tiny (5), so nsize shifted adds are
+    also the cheaper lowering."""
+    x32 = data.astype(jnp.float32)
+    sq = jnp.square(x32)
     half = nsize // 2
-    window = (1, nsize) + (1,) * (data.ndim - 2)
-    pads = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
-    ssum = lax.reduce_window(sq, jnp.zeros((), sq.dtype), lax.add, window,
-                             (1,) * data.ndim, pads)
-    return data / jnp.power(knorm + alpha * ssum / nsize, beta)
+    pad_cfg = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    padded = jnp.pad(sq, pad_cfg)
+    C = data.shape[1]
+    ssum = lax.slice_in_dim(padded, 0, C, axis=1)
+    for off in range(1, nsize):
+        ssum = ssum + lax.slice_in_dim(padded, off, off + C, axis=1)
+    out = x32 / jnp.power(knorm + alpha * ssum / nsize, beta)
+    return out.astype(data.dtype)
 
 
 # ----------------------------------------------------------------- dropout

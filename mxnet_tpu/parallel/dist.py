@@ -23,7 +23,8 @@ from functools import partial
 from typing import Optional
 
 __all__ = ["initialize", "is_initialized", "cluster_env", "rank",
-           "num_workers", "allreduce_sum", "broadcast", "barrier"]
+           "num_workers", "allreduce_sum", "broadcast", "barrier",
+           "heartbeat_start", "heartbeat_stop", "num_dead_nodes"]
 
 _INITIALIZED = False
 _COMM = None          # (mesh, local_device) cache
@@ -226,6 +227,8 @@ def _client():
 
 
 _hb_started = False
+_hb_stop = None           # threading.Event for the publisher thread
+_hb_thread = None
 # reader-side observations: rank -> (last counter, local time first seen)
 _hb_seen = {}
 
@@ -238,23 +241,24 @@ def heartbeat_start(period: float = 5.0) -> bool:
     staleness is judged on the reader's own clock, so cross-host clock
     skew cannot fake deaths. Idempotent; returns False when no
     coordination client exists (single process)."""
-    global _hb_started
+    global _hb_started, _hb_stop, _hb_thread
     import logging
     import threading
-    import time
     client = _client()
     if client is None:
         return False
     if _hb_started:
         return True
     _hb_started = True
+    _hb_stop = threading.Event()
 
     me = "mxnet_hb/%d" % rank()
+    stop = _hb_stop
 
     def beat():
         n = 0
         warned = False
-        while True:
+        while not stop.is_set():
             n += 1
             try:
                 try:
@@ -265,6 +269,7 @@ def heartbeat_start(period: float = 5.0) -> bool:
                     except Exception:
                         pass
                     client.key_value_set(me, str(n))
+                warned = False      # recovered: re-arm the warning
             except Exception as exc:
                 # transient coordinator hiccups must not kill the beat —
                 # a dead thread would report this live worker dead forever
@@ -272,11 +277,24 @@ def heartbeat_start(period: float = 5.0) -> bool:
                     logging.warning("heartbeat publish failed "
                                     "(will keep retrying): %s", exc)
                     warned = True
-            time.sleep(period)
+            stop.wait(period)
 
-    t = threading.Thread(target=beat, daemon=True, name="mxnet-heartbeat")
-    t.start()
+    _hb_thread = threading.Thread(target=beat, daemon=True,
+                                  name="mxnet-heartbeat")
+    _hb_thread.start()
     return True
+
+
+def heartbeat_stop(timeout: float = 2.0):
+    """Stop the publisher thread (e.g. before a deliberate clean exit, so
+    peers' ``get_num_dead_node`` sees this worker as *gone* rather than
+    freshly-beating). Idempotent."""
+    global _hb_started, _hb_stop, _hb_thread
+    if _hb_stop is not None:
+        _hb_stop.set()
+    if _hb_thread is not None:
+        _hb_thread.join(timeout)
+    _hb_started, _hb_stop, _hb_thread = False, None, None
 
 
 def num_dead_nodes(stale_after: float = 20.0, timeout_ms: int = 1000) -> int:

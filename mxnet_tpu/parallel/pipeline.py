@@ -56,17 +56,22 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
     stage_params : pytree
         Per-stage parameters stacked on a leading ``n_stages`` axis
         (see ``stack_stage_params``).
-    inputs : array (M, mb, ...)
+    inputs : array or pytree of arrays, each (M, mb, ...)
         ``M`` microbatches. ``M >= N`` keeps the bubble fraction at
-        ``(N-1)/(M+N-1)``.
+        ``(N-1)/(M+N-1)``. A pytree (e.g. ``{"data": ..., "label": ...}``)
+        lets the head see per-microbatch side inputs; a bare array is the
+        wire itself when ``first_fn`` is None.
     mesh : jax.sharding.Mesh with the ``axis`` dimension.
     first_fn : callable(first_params, raw_mb) -> wire, optional
         Input adapter owned by stage 0 (e.g. embedding lookup: int token
         ids -> hidden states). Its output defines the wire shape/dtype.
-        ``first_params`` ride replicated.
-    last_fn : callable(last_params, wire) -> out, optional
+        ``first_params`` ride replicated. ``raw_mb`` is the microbatch
+        slice of ``inputs`` (same pytree structure).
+    last_fn : callable(last_params, wire[, raw_mb]) -> out, optional
         Output head owned by stage N-1 (e.g. final norm + logits, or a
-        per-microbatch loss). Defines the returned shape.
+        per-microbatch loss). Defines the returned shape. A 3-argument
+        ``last_fn`` also receives the microbatch slice of ``inputs``
+        whose wire is finishing — how labels reach a loss head.
     remat : bool
         Wrap ``stage_fn`` in ``jax.checkpoint`` so backward recomputes
         stage activations per microbatch instead of storing all
@@ -75,6 +80,7 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
     Returns the (M, ...) per-microbatch outputs of ``last_fn`` (or of the
     last stage when ``last_fn`` is None).
     """
+    import inspect
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -82,21 +88,36 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
     from jax.experimental.shard_map import shard_map
 
     n_stages = mesh.shape[axis]
-    n_micro = inputs.shape[0]
+    leaves = jax.tree_util.tree_leaves(inputs)
+    n_micro = leaves[0].shape[0]
     if n_micro < 1:
         raise ValueError("need at least one microbatch")
+    tree_mb = lambda xs, t: jax.tree_util.tree_map(lambda a: a[t], xs)
 
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
 
-    # wire shape: what hops between devices each tick
-    if first_fn is None:
-        wire_sd = jax.eval_shape(lambda x: x[0], inputs)
+    # a 3-arg head also sees the finishing microbatch's raw inputs
+    # (labels for a loss head); keep the 2-arg form working
+    if last_fn is not None and \
+            len(inspect.signature(last_fn).parameters) >= 3:
+        head_fn = last_fn
+    elif last_fn is not None:
+        head_fn = lambda p, y, raw: last_fn(p, y)
     else:
-        wire_sd = jax.eval_shape(first_fn, first_params,
-                                 jax.eval_shape(lambda x: x[0], inputs))
-    out_sd = wire_sd if last_fn is None else \
-        jax.eval_shape(last_fn, last_params, wire_sd)
+        head_fn = None
+
+    # wire shape: what hops between devices each tick
+    raw_sd = jax.eval_shape(lambda x: tree_mb(x, 0), inputs)
+    if first_fn is None:
+        wire_sd = raw_sd
+        if not isinstance(wire_sd, jax.ShapeDtypeStruct):
+            raise ValueError(
+                "pytree inputs need a first_fn to define the wire")
+    else:
+        wire_sd = jax.eval_shape(first_fn, first_params, raw_sd)
+    out_sd = wire_sd if head_fn is None else \
+        jax.eval_shape(head_fn, last_params, wire_sd, raw_sd)
 
     # params: leading stage axis sharded over the pipe axis; inputs,
     # outputs, and the first/last adapters replicated (only stage 0
@@ -113,7 +134,7 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
 
         def step(carry, t):
             recv, outs = carry
-            raw = xs[jnp.clip(t, 0, n_micro - 1)]
+            raw = tree_mb(xs, jnp.clip(t, 0, n_micro - 1))
             z0 = raw if first_fn is None else first_fn(fparams, raw)
             x = jnp.where(idx == 0, z0, recv)
             y = stage_fn(local, x)
@@ -121,8 +142,9 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
             # output stays home and is collected below)
             send = lax.ppermute(
                 y, axis, perm=[(i, i + 1) for i in range(n_stages - 1)])
-            out = y if last_fn is None else last_fn(lparams, y)
             out_t = t - (n_stages - 1)
+            raw_out = tree_mb(xs, jnp.clip(out_t, 0, n_micro - 1))
+            out = y if head_fn is None else head_fn(lparams, y, raw_out)
             take = jnp.logical_and(idx == n_stages - 1,
                                    jnp.logical_and(out_t >= 0,
                                                    out_t < n_micro))

@@ -1,0 +1,78 @@
+"""Worker body for the dead-node detection test: rank N-1 dies abruptly
+(os._exit, no clean coordinator leave), survivors assert
+``get_num_dead_node() > 0`` via heartbeat staleness (reference:
+ps-lite heartbeats feeding kvstore.h:287; SURVEY §5.3).
+
+Run via tools/launch.py by tests/test_dist.py; NOT collected by pytest.
+No collectives happen after the death point — gloo would hang on a
+missing member; liveness flows through the coordinator KV store only.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    outdir = sys.argv[1]
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.parallel import dist
+
+    # fast staleness for the test: dead after 3s without a new beat
+    _config.set("MXNET_KVSTORE_HEARTBEAT_STALE_SECS", 3.0)
+
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    assert n >= 3, "dead-node test wants >= 3 workers"
+
+    # everyone synchronizes once while all are alive; all heartbeats seen
+    kv.init(0, mx.nd.ones((2, 2)))
+    kv.push(0, mx.nd.ones((2, 2)))
+    out = mx.nd.zeros((2, 2))
+    kv.pull(0, out=out)
+    assert kv.get_num_dead_node(0, timeout=2) == 0
+
+    if rank == n - 1:
+        # die without cleanup: heartbeat freezes at its last counter
+        os._exit(0)
+
+    # survivors: poll until the victim's beat goes stale (needs two
+    # observations of the same counter separated by the stale window)
+    deadline = time.monotonic() + 60
+    dead = 0
+    while time.monotonic() < deadline:
+        dead = kv.get_num_dead_node(0, timeout=2)
+        if dead > 0:
+            break
+        time.sleep(1.0)
+    assert dead > 0, "dead worker was never detected"
+    with open(os.path.join(outdir, "dead_seen_rank%d" % rank), "w") as f:
+        f.write(str(dead))
+    print("rank %d saw %d dead node(s) OK" % (rank, dead), flush=True)
+    sys.stdout.flush()
+    # exit order matters: rank 0 hosts the coordination service, so any
+    # survivor still holding a client when it vanishes gets a fatal
+    # "leader died" abort. Non-leaders publish done and hard-exit at
+    # once; the leader waits for their keys and leaves last. Hard exits
+    # everywhere skip jax's clean shutdown, whose barrier would wait on
+    # the dead member and flag the whole job fatal.
+    client = dist._client()
+    if rank != 0:
+        client.key_value_set("mxnet_dead_test_done/%d" % rank, "1")
+        os._exit(0)
+    for r in range(1, n - 1):
+        client.blocking_key_value_get("mxnet_dead_test_done/%d" % r, 30000)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,27 @@ def main():
     expect = 1.0 + n * (n + 1) / 2.0
     np.testing.assert_allclose(out.asnumpy(), expect)
 
+    # --- big-array path: with a tiny MXNET_KVSTORE_BIGARRAY_BOUND the
+    # fused flush must chunk the flattened buffer (reference: big-array
+    # server sharding, tests/nightly/dist_sync_kvstore.py:30-40) and the
+    # sum must still be exact; several keys staged before one pull also
+    # exercises the single-fused-allreduce path
+    from mxnet_tpu import config as _config
+    _config.set("MXNET_KVSTORE_BIGARRAY_BOUND", 1000)
+    big = np.arange(4096, dtype=np.float32).reshape(64, 64)
+    kv.init("big", mx.nd.zeros((64, 64)))
+    kv.init("small", mx.nd.zeros((3,)))
+    kv.push("big", mx.nd.array(big * (rank + 1)))
+    kv.push("small", mx.nd.array(np.full((3,), rank + 1, np.float32)))
+    bout = mx.nd.zeros((64, 64))
+    sout = mx.nd.zeros((3,))
+    kv.pull("big", out=bout)
+    kv.pull("small", out=sout)
+    scale = n * (n + 1) / 2.0
+    np.testing.assert_allclose(bout.asnumpy(), big * scale, rtol=1e-6)
+    np.testing.assert_allclose(sout.asnumpy(), scale)
+    _config.set("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000)
+
     # --- rank-dependent init must be overridden by rank 0's broadcast
     kv.init("w0", mx.nd.array(np.full((3,), float(rank), np.float32)))
     got = mx.nd.zeros((3,))

@@ -55,6 +55,7 @@ _SPD = (lambda a: (a @ a.T + 3 * np.eye(3)).astype(np.float32))(
 # from the sweep with the covering file as the reason
 COVERED_ELSEWHERE = {
     "Custom": "test_custom_op.py",
+    "MoE": "test_moe.py + test_gluon.py (routing exactness, bf16, grads)",
     "RNN": "test_rnn.py",
     "FlashAttention": "test_rtc.py",
     "MultiBoxPrior": "test_vision_ops.py",

@@ -1,0 +1,64 @@
+"""PipelineModule (module/pipeline_module.py): the Module-style user
+surface for GPipe pipeline parallelism, on the 8-device virtual mesh."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _stages(D=8, n_body=2):
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.FullyConnected(data, num_hidden=D, name="adapt",
+                               flatten=False)
+    body = []
+    for i in range(n_body):
+        x = mx.sym.Variable("x")
+        h = mx.sym.FullyConnected(x, num_hidden=D, name="b%d" % i,
+                                  flatten=False)
+        body.append(mx.sym.Activation(h, act_type="tanh"))
+    x = mx.sym.Variable("x")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(x, num_hidden=4, name="head"),
+        mx.sym.Variable("softmax_label"), name="softmax")
+    return [s0] + body + [head]
+
+
+def test_pipeline_module_trains_to_separable_task():
+    mod = mx.mod.PipelineModule(_stages(), n_microbatches=4)
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 6).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32) + 2 * (X[:, 1] > 0).astype(
+        np.float32)
+    db = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+    accs = []
+    for _ in range(250):
+        outs = mod.fit_step(db)
+        p = np.asarray(outs).reshape(8, 4)
+        accs.append(float((p.argmax(1) == Y).mean()))
+    assert accs[-1] >= 0.85, accs[-1]
+
+
+def test_pipeline_module_validations():
+    with pytest.raises(ValueError, match="3 stages"):
+        mx.mod.PipelineModule(_stages()[:2], n_microbatches=2)
+    mod = mx.mod.PipelineModule(_stages(), n_microbatches=3)
+    with pytest.raises(ValueError, match="divisible"):
+        mod.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("softmax_label", (8,))])
+
+
+def test_pipeline_module_rejects_aux_stages():
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.FullyConnected(data, num_hidden=8, name="adapt")
+    x = mx.sym.Variable("x")
+    bnb = mx.sym.BatchNorm(mx.sym.FullyConnected(x, num_hidden=8,
+                                                 name="b0"), name="bn0")
+    head = mx.sym.SoftmaxOutput(mx.sym.Variable("x"), name="softmax")
+    mod = mx.mod.PipelineModule([s0, bnb, bnb, head], n_microbatches=2)
+    with pytest.raises(mx.base.MXNetError, match="auxiliary"):
+        mod.bind(data_shapes=[("data", (4, 6))])
