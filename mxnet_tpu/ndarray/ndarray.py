@@ -532,13 +532,29 @@ def onehot_encode(indices: NDArray, out: NDArray) -> NDArray:
 
 # --------------------------------------------------------------- save/load
 
-def save(fname: str, data) -> None:
+def save(fname: str, data, format: str = "npz") -> None:
     """Save list/dict of NDArrays (reference: src/ndarray/ndarray.cc:668-777
-    Save/Load + MXNDArraySave). Container format: npz archive holding each
+    Save/Load + MXNDArraySave). Default container: npz archive holding each
     tensor plus an ordering manifest — same capability (named/ordered tensor
-    checkpoint), TPU-era container."""
+    checkpoint), TPU-era container. ``format="mxnet"`` writes the
+    reference's binary layout instead (magic 0x112 / NDARRAY_V1 records,
+    ndarray/legacy_format.py) for interchange with existing MXNet
+    tooling; ``load`` autodetects both."""
     if isinstance(data, NDArray):
         data = [data]
+    if format == "mxnet":
+        from . import legacy_format
+        from .. import filesystem as _fs
+        if isinstance(data, dict):
+            blob = {k: np.asarray(v.asnumpy()) for k, v in data.items()}
+        else:
+            blob = [np.asarray(a.asnumpy()) for a in data]
+        with _fs.open_uri(fname, "w") as path:
+            with open(path, "wb") as f:
+                f.write(legacy_format.save_bytes(blob))
+        return
+    if format != "npz":
+        raise ValueError("unknown save format %r" % format)
     if isinstance(data, dict):
         names = list(data.keys())
         arrays = [data[k] for k in names]
@@ -564,9 +580,19 @@ def save(fname: str, data) -> None:
 
 def load(fname: str):
     """(reference: mx.nd.load; remote URIs stage via mx.filesystem like
-    dmlc::Stream)."""
+    dmlc::Stream). Reads both the npz container and reference-era binary
+    ``.params`` blobs (autodetected by magic)."""
     from .. import filesystem as _fs
     with _fs.open_uri(fname, "r") as path:
+        with open(path, "rb") as f:
+            head = f.read(8)
+        from . import legacy_format
+        if legacy_format.is_legacy_params(head):
+            with open(path, "rb") as f:
+                out = legacy_format.load_bytes(f.read())
+            if isinstance(out, list):
+                return [array(a) for a in out]
+            return {k: array(v) for k, v in out.items()}
         with np.load(path, allow_pickle=False) as zf:
             manifest = [str(x) for x in zf["__manifest__"]]
             kind, keys = manifest[0], manifest[1:]

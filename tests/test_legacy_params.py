@@ -1,0 +1,182 @@
+"""Reference-binary .params compatibility (ndarray/legacy_format.py).
+
+The fixtures are hand-packed with struct against the reference layout
+(src/ndarray/ndarray.cc:666-770: NDARRAY_V1_MAGIC records inside the
+kMXAPINDArrayListMagic list container), so compatibility is pinned at
+the byte level rather than through our own writer alone.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import legacy_format as lf
+
+
+def _pack_v1(arr, dev=(1, 0)):
+    out = [struct.pack("<I", 0xF993FAC8),
+           struct.pack("<I", arr.ndim),
+           struct.pack("<%dq" % arr.ndim, *arr.shape)]
+    out.append(struct.pack("<ii", *dev))
+    flag = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+            np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+            np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+            np.dtype(np.int64): 6}[arr.dtype]
+    out.append(struct.pack("<i", flag))
+    out.append(arr.tobytes())
+    return b"".join(out)
+
+
+def _pack_v0(arr):
+    # pre-V1: the magic slot IS ndim, dims are uint32
+    out = [struct.pack("<I", arr.ndim),
+           struct.pack("<%dI" % arr.ndim, *arr.shape),
+           struct.pack("<ii", 1, 0), struct.pack("<i", 0),
+           arr.tobytes()]
+    return b"".join(out)
+
+
+def _container(blobs, names):
+    parts = [struct.pack("<QQ", 0x112, 0), struct.pack("<Q", len(blobs))]
+    parts += blobs
+    parts.append(struct.pack("<Q", len(names)))
+    for nm in names:
+        b = nm.encode()
+        parts.append(struct.pack("<Q", len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def test_parse_handpacked_v1_named():
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float64)
+    i = rng.randint(0, 100, (2, 2, 2)).astype(np.int32)
+    buf = _container([_pack_v1(w), _pack_v1(b, dev=(2, 0)), _pack_v1(i)],
+                     ["arg:w", "arg:b", "aux:i"])
+    out = lf.load_bytes(buf)
+    np.testing.assert_array_equal(out["arg:w"], w)
+    np.testing.assert_array_equal(out["arg:b"], b)
+    np.testing.assert_array_equal(out["aux:i"], i)
+
+
+def test_parse_handpacked_v0_legacy_and_anonymous_list():
+    rng = np.random.RandomState(1)
+    a = rng.randn(2, 3).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    buf = _container([_pack_v0(a), _pack_v0(b)], [])
+    out = lf.load_bytes(buf)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0], a)
+    np.testing.assert_array_equal(out[1], b)
+
+
+def test_save_bytes_roundtrip_and_magic():
+    rng = np.random.RandomState(2)
+    d = {"w": rng.randn(4, 2).astype(np.float32),
+         "idx": rng.randint(0, 9, (3,)).astype(np.int64),
+         "h": rng.randn(2).astype(np.float16)}
+    buf = lf.save_bytes(d)
+    assert lf.is_legacy_params(buf[:8])
+    out = lf.load_bytes(buf)
+    for k in d:
+        np.testing.assert_array_equal(out[k], d[k])
+        assert out[k].dtype == d[k].dtype
+
+
+def test_nd_save_load_mxnet_format(tmp_path):
+    p = str(tmp_path / "c.params")
+    d = {"a": mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3)),
+         "b": mx.nd.array(np.ones((4,), np.float32))}
+    mx.nd.save(p, d, format="mxnet")
+    # the on-disk head must carry the reference magic, not npz
+    with open(p, "rb") as f:
+        assert lf.is_legacy_params(f.read(8))
+    out = mx.nd.load(p)
+    np.testing.assert_array_equal(out["a"].asnumpy(),
+                                  d["a"].asnumpy())
+    np.testing.assert_array_equal(out["b"].asnumpy(),
+                                  d["b"].asnumpy())
+
+
+def test_zoo_pretrained_path_roundtrip(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+    rng = np.random.RandomState(3)
+    x = mx.nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+
+    net = vision.get_model("squeezenet1_0", classes=10)
+    net.initialize(mx.init.Xavier())
+    ref = net(x).asnumpy()
+    p = str(tmp_path / "sq.params")
+    mx.nd.save(p, {k: v.data() for k, v in net.collect_params().items()},
+               format="mxnet")
+
+    net2 = vision.get_model("squeezenet1_0", classes=10, pretrained=p)
+    np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+    with pytest.raises(ValueError, match="download"):
+        vision.get_model("squeezenet1_0", pretrained=True)
+
+
+def test_predictor_reference_era_checkpoint(tmp_path):
+    """A checkpoint in the reference's on-disk formats end to end —
+    symbol JSON (0.8-era schema) + binary .params with arg:/aux:
+    prefixes — must produce identical logits through Predictor."""
+    from mxnet_tpu.models import lenet
+    rng = np.random.RandomState(4)
+    sym = lenet.get_symbol(num_classes=10)
+    mod = mx.mod.Module(sym, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (2, 1, 28, 28))],
+             label_shapes=[("softmax_label", (2,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    x = rng.rand(2, 1, 28, 28).astype(np.float32)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+
+    jpath = str(tmp_path / "net-symbol.json")
+    ppath = str(tmp_path / "net-0000.params")
+    sym.save(jpath)
+    args, auxs = mod.get_params()
+    blob = {"arg:%s" % k: v for k, v in args.items()}
+    blob.update({"aux:%s" % k: v for k, v in auxs.items()})
+    mx.nd.save(ppath, blob, format="mxnet")
+
+    pred = mx.predictor.Predictor(jpath, ppath,
+                                  {"data": (2, 1, 28, 28)})
+    out = pred.forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_convert_params_cli(tmp_path):
+    import subprocess
+    import sys as _sys
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    src = str(tmp_path / "m.params")
+    d = {"arg:w": mx.nd.array(np.arange(4, dtype=np.float32)),
+         "aux:m": mx.nd.array(np.ones((2,), np.float32))}
+    mx.nd.save(src, d, format="mxnet")
+    out = str(tmp_path / "flat.params")
+    env = dict(_os.environ); env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable,
+                        _os.path.join(root, "tools", "convert_params.py"),
+                        src, out, "--strip-prefix"],
+                       capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    got = mx.nd.load(out)
+    assert sorted(got) == ["m", "w"]
+    np.testing.assert_array_equal(got["w"].asnumpy(),
+                                  d["arg:w"].asnumpy())
+
+
+def test_mixed_prefix_checkpoint_and_unsupported_dtype():
+    # mixed prefixed/unprefixed keys must strip cleanly (regression:
+    # an unguarded split crashed), and save_bytes must refuse dtypes the
+    # reference format cannot represent instead of silently casting
+    from mxnet_tpu.ndarray.legacy_format import strip_arg_aux
+    d = {"arg:w": 1, "aux:m": 2, "extra_stat": 3}
+    assert strip_arg_aux(d) == {"w": 1, "m": 2, "extra_stat": 3}
+    with pytest.raises(ValueError, match="type flag"):
+        lf.save_bytes({"ids": np.arange(3, dtype=np.uint64)})

@@ -77,3 +77,59 @@ def test_predictor_missing_param_raises(tmp_path):
         sym_json = f.read()
     with pytest.raises(ValueError):
         mx.Predictor(sym_json, {}, input_shapes={"data": (16, 1, 8, 8)})
+
+
+def test_predictor_export_runs_without_framework(tmp_path):
+    """Predictor.export -> StableHLO artifact executed by the standalone
+    loader (tools/predict_exported.py, no mxnet_tpu import) with
+    identical outputs — the amalgamation-deployment equivalent
+    (reference: amalgamation/Makefile, c_predict_api.h:77-178)."""
+    import subprocess
+    import sys as _sys
+    import os as _os
+    from mxnet_tpu.models import lenet
+
+    rng = np.random.RandomState(5)
+    sym = lenet.get_symbol(num_classes=10)
+    mod = mx.mod.Module(sym, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (2, 1, 28, 28))],
+             label_shapes=[("softmax_label", (2,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    args, auxs = mod.get_params()
+    blob = {"arg:%s" % k: v for k, v in args.items()}
+    blob.update({"aux:%s" % k: v for k, v in auxs.items()})
+    pred = mx.predictor.Predictor(sym.tojson(), blob,
+                                  {"data": (2, 1, 28, 28)}, ctx=mx.cpu(0))
+    x = rng.rand(2, 1, 28, 28).astype(np.float32)
+    ref = pred.forward(data=x)[0].asnumpy()
+
+    art = str(tmp_path / "lenet.mxprog")
+    pred.export(art)
+
+    # in-process loader check (imports only jax + numpy)
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    _sys.path.insert(0, _os.path.join(root, "tools"))
+    try:
+        from predict_exported import load_artifact
+    finally:
+        _sys.path.pop(0)
+    call, manifest = load_artifact(art)
+    assert manifest["inputs"] == ["data"]
+    out = call(data=x)[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # subprocess proof: the CLI runs from a neutral cwd with no repo on
+    # sys.path — the artifact needs jax only, not the framework
+    xp = str(tmp_path / "x.npy")
+    np.save(xp, x)
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    r = subprocess.run(
+        [_sys.executable, _os.path.join(root, "tools",
+                                        "predict_exported.py"),
+         art, "--input", "data=%s" % xp],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "output[0] shape=(2, 10)" in r.stdout

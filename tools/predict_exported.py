@@ -1,0 +1,70 @@
+"""Run a Predictor.export() artifact with nothing but jax installed.
+
+This is the deployment half of the amalgamation story (the reference
+ships a single-file predict-only build, amalgamation/Makefile +
+c_predict_api.h): the artifact zip holds a serialized StableHLO program,
+the frozen weights, and a manifest — no framework import happens here.
+
+  python tools/predict_exported.py model.mxprog --input data=batch.npy
+  python tools/predict_exported.py model.mxprog          # random inputs
+"""
+import argparse
+import io
+import json
+import sys
+import zipfile
+
+import numpy as np
+
+
+def load_artifact(path):
+    """Returns (call, manifest): ``call(**inputs) -> list of np arrays``."""
+    from jax import export as jexport
+
+    with zipfile.ZipFile(path) as z:
+        manifest = json.loads(z.read("manifest.json"))
+        if manifest.get("format") != "mxnet_tpu.exported/1":
+            raise ValueError("not a mxnet_tpu export artifact: %s" % path)
+        exported = jexport.deserialize(z.read("program.stablehlo"))
+        with np.load(io.BytesIO(z.read("weights.npz"))) as wz:
+            weights = {k: wz[k] for k in wz.files}
+
+    def call(**inputs):
+        missing = [n for n in manifest["inputs"] if n not in inputs]
+        if missing:
+            raise ValueError("missing inputs: %s" % missing)
+        flat = [weights[n] for n in manifest["weights"]]
+        flat += [np.asarray(inputs[n]) for n in manifest["inputs"]]
+        return [np.asarray(o) for o in exported.call(*flat)]
+
+    return call, manifest
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("artifact")
+    p.add_argument("--input", action="append", default=[],
+                   metavar="name=path.npy",
+                   help="input tensor from an .npy file; unspecified "
+                        "inputs get seeded random data")
+    args = p.parse_args()
+
+    call, manifest = load_artifact(args.artifact)
+    feeds = {}
+    for spec in args.input:
+        name, path = spec.split("=", 1)
+        feeds[name] = np.load(path)
+    rng = np.random.RandomState(0)
+    for name in manifest["inputs"]:
+        if name not in feeds:
+            feeds[name] = rng.uniform(
+                -1, 1, manifest["input_shapes"][name]).astype(np.float32)
+    outs = call(**feeds)
+    for i, o in enumerate(outs):
+        print("output[%d] shape=%s dtype=%s mean=%.6f" %
+              (i, o.shape, o.dtype, float(np.mean(o))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
