@@ -1,0 +1,140 @@
+"""Gluon actor-critic on CartPole — the RL breadth example.
+
+Capability twin of the reference's ``example/gluon/actor_critic.py``
+(policy+value net, REINFORCE-with-baseline updates from episode returns).
+The gym dependency is replaced by an inline CartPole physics step (the
+standard cart-pole ODE with Euler integration), so the example is fully
+self-contained; the gate is the mean episode length growing well past
+the random-policy baseline.
+
+Run:  python examples/actor_critic.py --num-episodes 150
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class CartPole(object):
+    """Classic cart-pole balance task (standard dynamics constants)."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+        self.g, self.mc, self.mp, self.l = 9.8, 1.0, 0.1, 0.5
+        self.force, self.dt = 10.0, 0.02
+        self.x_lim, self.th_lim = 2.4, 12 * np.pi / 180
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        f = self.force if action == 1 else -self.force
+        costh, sinth = np.cos(th), np.sin(th)
+        m = self.mc + self.mp
+        temp = (f + self.mp * self.l * thd ** 2 * sinth) / m
+        thacc = (self.g * sinth - costh * temp) / \
+            (self.l * (4.0 / 3.0 - self.mp * costh ** 2 / m))
+        xacc = temp - self.mp * self.l * thacc * costh / m
+        x, xd = x + self.dt * xd, xd + self.dt * xacc
+        th, thd = th + self.dt * thd, thd + self.dt * thacc
+        self.s = np.array([x, xd, th, thd], np.float32)
+        done = abs(x) > self.x_lim or abs(th) > self.th_lim
+        return self.s.copy(), 1.0, done
+
+
+def main():
+    p = argparse.ArgumentParser(description="actor-critic cart-pole")
+    p.add_argument("--num-episodes", type=int, default=150)
+    p.add_argument("--max-steps", type=int, default=200)
+    p.add_argument("--gamma", type=float, default=0.99)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, Trainer
+
+    class ActorCritic(nn.HybridSequential):
+        """Shared body; policy logits + value head (reference
+        actor_critic.py Net)."""
+
+        def __init__(self):
+            super().__init__()
+            self.body = nn.Dense(64, activation="relu", in_units=4)
+            self.policy = nn.Dense(2, in_units=64)
+            self.value = nn.Dense(1, in_units=64)
+            for b in (self.body, self.policy, self.value):
+                self.register_child(b)
+
+        def forward(self, x):
+            h = self.body(x)
+            return self.policy(h), self.value(h)
+
+    net = ActorCritic()
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+    env = CartPole(args.seed)
+    rng = np.random.RandomState(args.seed + 1)
+
+    lengths = []
+    for ep in range(args.num_episodes):
+        s = env.reset()
+        states, actions, rewards = [], [], []
+        for _ in range(args.max_steps):
+            logits, _ = net(mx.nd.array(s[None]))
+            z = logits.asnumpy()[0]
+            probs = np.exp(z - z.max())    # stabilized softmax
+            probs /= probs.sum()
+            a = int(rng.rand() < probs[1])
+            s2, r, done = env.step(a)
+            states.append(s)
+            actions.append(a)
+            rewards.append(r)
+            s = s2
+            if done:
+                break
+        lengths.append(len(rewards))
+
+        # discounted returns, normalized
+        R, rets = 0.0, []
+        for r in reversed(rewards):
+            R = r + args.gamma * R
+            rets.append(R)
+        rets = np.asarray(rets[::-1], np.float32)
+        rets = (rets - rets.mean()) / (rets.std() + 1e-6)
+
+        xs = mx.nd.array(np.stack(states))
+        acts = np.asarray(actions)
+        retnd = mx.nd.array(rets)
+        with mx.autograd.record():
+            logits, values = net(xs)
+            logp = mx.nd.log_softmax(logits, axis=-1)
+            chosen = mx.nd.pick(logp, mx.nd.array(
+                acts.astype(np.float32)), axis=1)
+            values = mx.nd.reshape(values, (-1,))
+            adv = retnd - values
+            # policy gradient with the critic baseline + value regression
+            actor = -mx.nd.mean(chosen * mx.nd.stop_gradient(adv))
+            critic = mx.nd.mean(mx.nd.square(adv))
+            loss = actor + 0.5 * critic
+        loss.backward()
+        trainer.step(1)
+        if (ep + 1) % 25 == 0:
+            print("Episode[%d] mean-len(last 25)=%.1f"
+                  % (ep + 1, np.mean(lengths[-25:])), flush=True)
+
+    first = np.mean(lengths[:25])
+    last = np.mean(lengths[-25:])
+    print("mean episode length: first25=%.1f last25=%.1f" % (first, last))
+    assert last > first * 1.5, "actor-critic did not improve"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

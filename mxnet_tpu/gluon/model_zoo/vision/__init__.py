@@ -38,46 +38,6 @@ def _register_models():
 _register_models()
 
 
-def _suffix_map(names):
-    """Map name-scope-stripped suffixes to full names: cut the shared
-    prefix at its last underscore, so 'squeezenet0_conv2d0_weight' and
-    'squeezenet1_conv2d0_weight' meet at 'conv2d0_weight' (v0.11 gluon
-    saves full prefixed names; instance counters differ across runs)."""
-    import os.path as _osp
-    names = list(names)
-    pref = _osp.commonprefix(names)
-    cut = pref.rfind("_") + 1
-    return {n[cut:]: n for n in names}
-
-
-def _load_pretrained(net, path):
-    from .... import ndarray as nd
-    data = nd.load(path)
-    if isinstance(data, list):
-        raise ValueError(
-            "pretrained file %r holds an unnamed array list; a named "
-            "parameter dict is required" % path)
-    from ....ndarray.legacy_format import strip_arg_aux
-    data = strip_arg_aux(data)
-    params = net.collect_params()
-    by_suffix = None
-    for name in params.keys():
-        src = name
-        if src not in data:
-            if by_suffix is None:
-                by_suffix = _suffix_map(data.keys())
-                net_suffix = _suffix_map(params.keys())
-            suf = next((s for s, n in net_suffix.items() if n == name),
-                       None)
-            src = by_suffix.get(suf)
-            if src is None:
-                raise ValueError(
-                    "Parameter %s missing in pretrained file %r "
-                    "(has e.g. %s)" % (name, path, sorted(data)[:3]))
-        params[name]._load_init(data[src], None)
-    return net
-
-
 def get_model(name, pretrained=False, **kwargs):
     """Create a model by name (reference: model_zoo/__init__.py
     get_model). ``pretrained`` may be a checkpoint path/URI — see the
@@ -87,15 +47,8 @@ def get_model(name, pretrained=False, **kwargs):
         raise ValueError(
             "Model %s is not supported. Available: %s"
             % (name, sorted(_models.keys())))
-    net = _models[name](**kwargs)
-    if pretrained:
-        if pretrained is True:
-            raise ValueError(
-                "pretrained=True needs the reference's download store, "
-                "which this environment cannot reach; pass a checkpoint "
-                "path (get_model(name, pretrained='/path/model.params'))")
-        _load_pretrained(net, pretrained)
-    return net
+    # factories handle pretrained themselves (vision/_pretrained.py)
+    return _models[name](pretrained=pretrained, **kwargs)
 
 
 __all__ = ["get_model"] + sorted(_models.keys())

@@ -1,0 +1,61 @@
+"""Shared pretrained-checkpoint loading for the vision zoo factories.
+
+``pretrained`` semantics (reference: model_zoo downloads from its model
+store, model_store.py:1-118): ``True`` is unavailable here (no egress)
+and raises; a path/URI string loads local weights — this zoo's own
+``save_params`` output or a reference-era binary ``.params`` blob
+(``arg:``/``aux:`` module prefixes stripped; name-scope instance
+counters matched by common-prefix suffix).
+"""
+from __future__ import annotations
+
+__all__ = ["finish_pretrained"]
+
+
+def _suffix_map(names):
+    """Map name-scope-stripped suffixes to full names: cut the shared
+    prefix at its last underscore, so 'squeezenet0_conv2d0_weight' and
+    'squeezenet1_conv2d0_weight' meet at 'conv2d0_weight' (v0.11 gluon
+    saves full prefixed names; instance counters differ across runs)."""
+    import os.path as _osp
+    names = list(names)
+    pref = _osp.commonprefix(names)
+    cut = pref.rfind("_") + 1
+    return {n[cut:]: n for n in names}
+
+
+def finish_pretrained(net, pretrained):
+    """Apply the ``pretrained`` argument to a freshly built net."""
+    if not pretrained:
+        return net
+    if pretrained is True:
+        raise ValueError(
+            "pretrained=True needs the reference's download store, which "
+            "this environment cannot reach; pass a checkpoint path "
+            "(pretrained='/path/model.params')")
+    from .... import ndarray as nd
+    from ....ndarray.legacy_format import strip_arg_aux
+    data = nd.load(pretrained)
+    if isinstance(data, list):
+        raise ValueError(
+            "pretrained file %r holds an unnamed array list; a named "
+            "parameter dict is required" % pretrained)
+    data = strip_arg_aux(data)
+    params = net.collect_params()
+    by_suffix = net_suffix = None
+    for name in params.keys():
+        src = name
+        if src not in data:
+            if by_suffix is None:
+                by_suffix = _suffix_map(data.keys())
+                net_suffix = _suffix_map(params.keys())
+            suf = next((s for s, n in net_suffix.items() if n == name),
+                       None)
+            src = by_suffix.get(suf)
+            if src is None:
+                raise ValueError(
+                    "Parameter %s missing in pretrained file %r "
+                    "(has e.g. %s)" % (name, pretrained,
+                                       sorted(data)[:3]))
+        params[name]._load_init(data[src], None)
+    return net

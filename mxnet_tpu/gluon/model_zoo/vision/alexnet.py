@@ -1,4 +1,5 @@
 """Gluon AlexNet (reference: model_zoo/vision/alexnet.py)."""
+from ._pretrained import finish_pretrained
 from ...block import HybridBlock
 from ... import nn
 
@@ -42,6 +43,4 @@ class AlexNet(HybridBlock):
 
 def alexnet(pretrained=False, **kwargs):
     """(reference: alexnet.py alexnet)."""
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return AlexNet(**kwargs)
+    return finish_pretrained(AlexNet(**kwargs), pretrained)

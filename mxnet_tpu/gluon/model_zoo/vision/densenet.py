@@ -1,5 +1,6 @@
 """Gluon DenseNet (reference: model_zoo/vision/densenet.py —
 121/161/169/201)."""
+from ._pretrained import finish_pretrained
 from ...block import HybridBlock
 from ... import nn
 
@@ -86,10 +87,10 @@ densenet_spec = {
 
 
 def _get_densenet(num_layers, pretrained=False, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    return finish_pretrained(
+        DenseNet(num_init_features, growth_rate, block_config, **kwargs),
+        pretrained)
 
 
 def densenet121(**kwargs):

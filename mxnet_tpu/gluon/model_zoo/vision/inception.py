@@ -1,4 +1,5 @@
 """Gluon Inception-v3 (reference: model_zoo/vision/inception.py)."""
+from ._pretrained import finish_pretrained
 from ...block import HybridBlock
 from ... import nn
 
@@ -158,6 +159,4 @@ class Inception3(HybridBlock):
 
 def inception_v3(pretrained=False, **kwargs):
     """(reference: inception.py inception_v3)."""
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return Inception3(**kwargs)
+    return finish_pretrained(Inception3(**kwargs), pretrained)

@@ -1,6 +1,7 @@
 """Gluon MobileNet v1 (capability twin of the reference's
 example/image-classification/symbols/mobilenet.py, in gluon form —
 depthwise-separable convs map to grouped XLA convolutions)."""
+from ._pretrained import finish_pretrained
 from ...block import HybridBlock
 from ... import nn
 
@@ -50,24 +51,16 @@ class MobileNet(HybridBlock):
 
 
 def mobilenet1_0(pretrained=False, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return MobileNet(1.0, **kwargs)
+    return finish_pretrained(MobileNet(1.0, **kwargs), pretrained)
 
 
 def mobilenet0_75(pretrained=False, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return MobileNet(0.75, **kwargs)
+    return finish_pretrained(MobileNet(0.75, **kwargs), pretrained)
 
 
 def mobilenet0_5(pretrained=False, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return MobileNet(0.5, **kwargs)
+    return finish_pretrained(MobileNet(0.5, **kwargs), pretrained)
 
 
 def mobilenet0_25(pretrained=False, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return MobileNet(0.25, **kwargs)
+    return finish_pretrained(MobileNet(0.25, **kwargs), pretrained)

@@ -2,6 +2,7 @@
 101/152 in both versions)."""
 from __future__ import annotations
 
+from ._pretrained import finish_pretrained
 from ...block import HybridBlock
 from ... import nn
 
@@ -243,14 +244,12 @@ def get_resnet(version, num_layers, pretrained=False, **kwargs):
     assert num_layers in resnet_spec, \
         "Invalid resnet depth %d; options: %s" % (num_layers,
                                                   sorted(resnet_spec))
-    if pretrained:
-        raise ValueError("pretrained weights are unavailable in this "
-                         "no-egress environment")
     block_type, layers, channels = resnet_spec[num_layers]
     assert version in (1, 2)
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    return finish_pretrained(
+        resnet_class(block_class, layers, channels, **kwargs), pretrained)
 
 
 def resnet18_v1(**kwargs):

@@ -1,4 +1,5 @@
 """Gluon SqueezeNet (reference: model_zoo/vision/squeezenet.py — 1.0/1.1)."""
+from ._pretrained import finish_pretrained
 from ...block import HybridBlock
 from ... import nn
 
@@ -88,12 +89,8 @@ class SqueezeNet(HybridBlock):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return SqueezeNet("1.0", **kwargs)
+    return finish_pretrained(SqueezeNet("1.0", **kwargs), pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
-    return SqueezeNet("1.1", **kwargs)
+    return finish_pretrained(SqueezeNet("1.1", **kwargs), pretrained)

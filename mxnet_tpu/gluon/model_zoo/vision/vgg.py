@@ -1,4 +1,5 @@
 """Gluon VGG (reference: model_zoo/vision/vgg.py — 11/13/16/19 ± BN)."""
+from ._pretrained import finish_pretrained
 from ...block import HybridBlock
 from ... import nn
 
@@ -48,10 +49,8 @@ class VGG(HybridBlock):
 
 def get_vgg(num_layers, pretrained=False, **kwargs):
     """(reference: vgg.py get_vgg)."""
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (no egress)")
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    return finish_pretrained(VGG(layers, filters, **kwargs), pretrained)
 
 
 def vgg11(**kwargs):

@@ -14,6 +14,11 @@ Module's fused train step, Gluon blocks, autograd — because "Custom" is an
 ordinary registry op. This mirrors how the reference routes custom ops
 through the engine as opaque async ops (custom-inl.h Push), at the same
 cost model: a host round-trip per call, so use it for glue, not hot loops.
+
+Backend note: host callbacks need a runtime with send/recv support —
+standard CPU/GPU/TPU PJRT runtimes have it; remote-tunnel plugins (e.g.
+the experimental axon proxy) may not, in which case custom ops run on
+the CPU backend only.
 """
 from __future__ import annotations
 
@@ -21,7 +26,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class"]
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class",
+           "PythonOp", "NumpyOp", "NDArrayOp"]
 
 _PROP_REGISTRY: Dict[str, type] = {}
 
@@ -224,3 +230,126 @@ def _register_custom_op():
 
 
 _register_custom_op()
+
+
+# -------------------------------------------------- legacy frontend classes
+
+
+class PythonOp(object):
+    """Deprecated-but-supported base for the 0.x custom-op style
+    (reference: python/mxnet/operator.py:36 PythonOp — predates
+    CustomOp/CustomOpProp). Subclass :class:`NumpyOp` or
+    :class:`NDArrayOp`; ``get_symbol(*args)`` splices the op into a
+    Symbol graph. Internally each instance registers itself as a modern
+    CustomOpProp, so the legacy surface rides the same pure_callback +
+    custom_vjp machinery as ``mx.sym.Custom``.
+    """
+
+    _counter = [0]
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+        self._op_type = None
+
+    # -- the legacy overridables (reference signatures)
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        """Returns (in_shapes, out_shapes) — the legacy two-tuple."""
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -- modern bridge
+    def _numpy_mode(self):
+        raise NotImplementedError("use NumpyOp or NDArrayOp")
+
+    def _ensure_registered(self):
+        if self._op_type is not None:
+            return self._op_type
+        PythonOp._counter[0] += 1
+        op_type = "_legacy_pyop_%d" % PythonOp._counter[0]
+        legacy = self
+        numpy_mode = self._numpy_mode()
+
+        class _Adapter(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                if numpy_mode:
+                    ins = [a.asnumpy() for a in in_data]
+                    outs = [o.asnumpy() for o in out_data]
+                    legacy.forward(in_data=ins, out_data=outs)
+                    for dst, src in zip(out_data, outs):
+                        self.assign(dst, "write", src)
+                else:
+                    legacy.forward(in_data=in_data, out_data=out_data)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                if numpy_mode:
+                    ogs = [g.asnumpy() for g in out_grad]
+                    ins = [a.asnumpy() for a in in_data]
+                    outs = [o.asnumpy() for o in out_data]
+                    igs = [g.asnumpy() for g in in_grad]
+                    legacy.backward(out_grad=ogs, in_data=ins,
+                                    out_data=outs, in_grad=igs)
+                    for dst, src in zip(in_grad, igs):
+                        self.assign(dst, "write", src)
+                else:
+                    legacy.backward(out_grad=out_grad, in_data=in_data,
+                                    out_data=out_data, in_grad=in_grad)
+
+        class _Prop(CustomOpProp):
+            def __init__(self, **_):
+                super().__init__(need_top_grad=legacy.need_top_grad())
+
+            def list_arguments(self):
+                return list(legacy.list_arguments())
+
+            def list_outputs(self):
+                return list(legacy.list_outputs())
+
+            def infer_shape(self, in_shape):
+                ishapes, oshapes = legacy.infer_shape(in_shape)
+                return ishapes, oshapes, []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _Adapter()
+
+        _PROP_REGISTRY[op_type] = _Prop
+        self._op_type = op_type
+        return op_type
+
+    def get_symbol(self, *args, **kwargs):
+        """Splice this op into a symbolic graph (reference: PythonOp
+        get_symbol -> the Custom symbol)."""
+        from . import symbol as sym
+        op_type = self._ensure_registered()
+        return sym.Custom(*args, op_type=op_type, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy custom op (reference: operator.py:143): ``forward``/
+    ``backward`` receive numpy arrays and mutate ``out_data``/``in_grad``
+    in place."""
+
+    def _numpy_mode(self):
+        return True
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray custom op (reference: operator.py:243): same
+    contract with NDArrays (assign via ``arr[:] = ...``)."""
+
+    def _numpy_mode(self):
+        return False

@@ -218,12 +218,14 @@ class Predictor(object):
             "num_outputs": len(sym.list_outputs()),
             "platforms": list(exported.platforms),
         }
-        with zipfile.ZipFile(path, "w") as z:
-            z.writestr("manifest.json", json.dumps(manifest, indent=1))
-            z.writestr("program.stablehlo", exported.serialize())
-            buf = io.BytesIO()
-            np.savez(buf, **weights)
-            z.writestr("weights.npz", buf.getvalue())
+        from . import filesystem as _fs
+        with _fs.open_uri(path, "w") as local:   # s3://, hdfs://, local
+            with zipfile.ZipFile(local, "w") as z:
+                z.writestr("manifest.json", json.dumps(manifest, indent=1))
+                z.writestr("program.stablehlo", exported.serialize())
+                buf = io.BytesIO()
+                np.savez(buf, **weights)
+                z.writestr("weights.npz", buf.getvalue())
         return path
 
     # ------------------------------------------------------------ loaders

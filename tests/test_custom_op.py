@@ -124,3 +124,75 @@ def test_custom_infer_shape_through_symbol():
     args = out.list_arguments()
     assert arg_shapes[args.index("cs_label")] == (8,)
     assert out_shapes == [(8, 5)]
+
+
+def test_legacy_numpy_op_softmax():
+    """NumpyOp shim (reference operator.py:143): the classic softmax
+    example from the reference's example/numpy-ops, run through Module."""
+    class NumpySoftmax(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            data_shape = in_shape[0]
+            label_shape = (in_shape[0][0],)
+            return [data_shape, label_shape], [data_shape]
+
+        def forward(self, in_data, out_data):
+            x = in_data[0]
+            y = out_data[0]
+            y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+            y /= y.sum(axis=1, keepdims=True)
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            l = in_data[1].astype(int)
+            y = out_data[0]
+            dx = in_grad[0]
+            dx[:] = y
+            dx[np.arange(l.shape[0]), l] -= 1.0
+
+    op = NumpySoftmax()
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = op.get_symbol(fc, mx.sym.Variable("softmax_label"),
+                        name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    yl = (x[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, yl, batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=12)
+    it.reset()
+    mod.forward(next(it), is_train=False)
+    p = mod.get_outputs()[0].asnumpy()
+    acc = (p.argmax(1) == yl[:8]).mean()
+    assert acc >= 0.75, acc
+
+
+def test_legacy_ndarray_op_scale():
+    """NDArrayOp shim (reference operator.py:243): forward/backward get
+    NDArrays and assign via slicing; gradient must flow."""
+    class Scale(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 3.0
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 3.0
+
+    op = Scale()
+    x = mx.sym.Variable("data")
+    net = op.get_symbol(x, name="scale3")
+    ex = net.simple_bind(ctx=mx.cpu(0), data=(2, 3))
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    out = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, 3.0)
+    ex.backward(mx.nd.array(np.full((2, 3), 2.0, np.float32)))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 6.0)

@@ -133,3 +133,20 @@ def test_pipeline_moe_transformer_cli():
                "--experts", "4", "--num-epochs", "2", "--num-batches",
                "10", "--d-model", "32", "--seq-len", "16")
     assert "final-ppl=" in out
+
+
+@pytest.mark.slow
+def test_super_resolution_cli():
+    """ESPCN-style sub-pixel upscaling (reference
+    example/gluon/super_resolution.py parity): PSNR must beat nearest."""
+    out = _run("super_resolution.py", "--num-epochs", "5",
+               "--num-examples", "60")
+    assert "PSNR" in out
+
+
+@pytest.mark.nightly
+def test_actor_critic_cli():
+    """Actor-critic RL (reference example/gluon/actor_critic.py parity):
+    mean episode length must grow 1.5x over training."""
+    out = _run("actor_critic.py", "--num-episodes", "120")
+    assert "mean episode length" in out
