@@ -65,11 +65,10 @@ def main():
 
         def __init__(self):
             super().__init__()
+            # Block.__setattr__ auto-registers Block-valued attributes
             self.body = nn.Dense(64, activation="relu", in_units=4)
             self.policy = nn.Dense(2, in_units=64)
             self.value = nn.Dense(1, in_units=64)
-            for b in (self.body, self.policy, self.value):
-                self.register_child(b)
 
         def forward(self, x):
             h = self.body(x)

@@ -755,6 +755,7 @@ def _derive_param_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]]):
 
     derived: Dict[str, Tuple[int, ...]] = {}
     shapes: Dict[Tuple[int, int], Tuple[int, ...]] = {}  # (node id, out idx)
+    eval_memo: Dict[tuple, Optional[tuple]] = {}         # per-call memo
 
     def shape_of(entry):
         node, idx = entry
@@ -853,7 +854,12 @@ def _derive_param_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]]):
         except (TypeError, KeyError, ValueError):
             pass
 
-        # ---- abstract-evaluate this node if all inputs are now known
+        # ---- abstract-evaluate this node if all inputs are now known.
+        # Repeated structures (the 12 identical transformer blocks, say)
+        # produce the same (op, attrs, input shapes) over and over; memoize
+        # so each unique signature traces once — for custom_vjp-heavy ops
+        # (flash attention) this is the difference between seconds and
+        # minutes of bind time.
         in_shapes = [shape_of(e) for e in node.inputs]
         if any(s is None for s in in_shapes):
             continue
@@ -864,6 +870,14 @@ def _derive_param_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]]):
             params = {}
         if "_is_train" in params:
             attrs.setdefault("_is_train", True)
+        ckey = (node.op.name, tuple(in_shapes),
+                tuple(sorted((k, repr(v)) for k, v in attrs.items())))
+        if ckey in eval_memo:
+            outs = eval_memo[ckey]
+            if outs is not None:
+                for i, o in enumerate(outs):
+                    shapes[(id(node), i)] = o
+            continue
         try:
             abstract_in = [jax.ShapeDtypeStruct(s, np.float32)
                            for s in in_shapes]
@@ -876,10 +890,12 @@ def _derive_param_shapes(sym: Symbol, known: Dict[str, Tuple[int, ...]]):
                     lambda *xs: node.op.fn(*xs, **attrs), *abstract_in)
             if not isinstance(outs, tuple):
                 outs = (outs,)
-            for i, o in enumerate(outs):
-                shapes[(id(node), i)] = tuple(o.shape)
+            out_shapes = tuple(tuple(o.shape) for o in outs)
+            eval_memo[ckey] = out_shapes
+            for i, o in enumerate(out_shapes):
+                shapes[(id(node), i)] = o
         except Exception:
-            pass
+            eval_memo[ckey] = None
     return derived
 
 

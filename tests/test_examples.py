@@ -139,7 +139,7 @@ def test_pipeline_moe_transformer_cli():
 def test_super_resolution_cli():
     """ESPCN-style sub-pixel upscaling (reference
     example/gluon/super_resolution.py parity): PSNR must beat nearest."""
-    out = _run("super_resolution.py", "--num-epochs", "5",
+    out = _run("super_resolution.py", "--num-epochs", "14",
                "--num-examples", "60")
     assert "PSNR" in out
 
