@@ -58,6 +58,7 @@ def main():
 
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import nn, Trainer
+    np.random.seed(args.seed)   # initializers draw from the global RNG
 
     class ActorCritic(nn.HybridSequential):
         """Shared body; policy logits + value head (reference
