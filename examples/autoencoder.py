@@ -1,0 +1,108 @@
+"""Stacked autoencoder with layer-wise pretraining then fine-tuning.
+
+Capability twin of the reference's ``example/autoencoder`` (Xie et al.'s
+DEC pretraining stage: greedy layer-wise denoising pretrain, then
+end-to-end fine-tune). Data is a mixture of low-rank gaussian clusters,
+so reconstruction error has a known floor well below the identity-free
+baseline (predicting the mean).
+
+Run:  python examples/autoencoder.py --num-epochs 12
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+DIM = 32
+
+
+def synth_data(n, seed=0):
+    """Points near a 4-dim linear manifold inside DIM dims + noise."""
+    rng = np.random.RandomState(seed)
+    basis = rng.randn(4, DIM).astype(np.float32)
+    codes = rng.randn(n, 4).astype(np.float32)
+    return codes @ basis + 0.05 * rng.randn(n, DIM).astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description="stacked autoencoder")
+    p.add_argument("--num-epochs", type=int, default=12)
+    p.add_argument("--pretrain-epochs", type=int, default=4)
+    p.add_argument("--num-examples", type=int, default=1500)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=5)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, Trainer
+    np.random.seed(args.seed)
+
+    x = synth_data(args.num_examples)
+    n_val = args.num_examples // 5
+    tr, va = x[n_val:], x[:n_val]
+
+    dims = [DIM, 16, 4]
+    encoders = [nn.Dense(dims[i + 1], activation=None if i == len(dims) - 2
+                         else "relu", in_units=dims[i])
+                for i in range(len(dims) - 1)]
+    decoders = [nn.Dense(dims[i], activation=None if i == 0 else "relu",
+                         in_units=dims[i + 1])
+                for i in range(len(dims) - 1)]
+    for blk in encoders + decoders:
+        blk.initialize(mx.init.Xavier())
+
+    def run_epochs(param_blocks, fwd, epochs, data, tag):
+        trainer = Trainer(sum([list(b.collect_params().values())
+                               for b in param_blocks], []),
+                          "adam", {"learning_rate": args.lr})
+        nb = len(data) // args.batch_size
+        if nb < 1:
+            p.error("--batch-size %d exceeds the %d-row training slice"
+                    % (args.batch_size, len(data)))
+        for ep in range(epochs):
+            tot = 0.0
+            for b in range(nb):
+                xb = mx.nd.array(data[b * args.batch_size:
+                                      (b + 1) * args.batch_size])
+                with mx.autograd.record():
+                    loss = mx.nd.mean(mx.nd.square(fwd(xb) - xb))
+                loss.backward()
+                trainer.step(args.batch_size)
+                tot += float(loss.asnumpy())
+            print("%s epoch[%d] mse=%.5f" % (tag, ep, tot / nb),
+                  flush=True)
+
+    # --- greedy layer-wise pretraining (reference autoencoder.py
+    # layerwise_pretrain): train (enc_i, dec_i) on the frozen encoding
+    feats = tr
+    for i, (enc, dec) in enumerate(zip(encoders, decoders)):
+        run_epochs([enc, dec], lambda z, e=enc, d=dec: d(e(z)),
+                   args.pretrain_epochs,
+                   feats, "pretrain-layer%d" % i)
+        feats = enc(mx.nd.array(feats)).asnumpy()
+
+    # --- end-to-end fine-tune of the full stack
+    def full(z):
+        h = z
+        for enc in encoders:
+            h = enc(h)
+        for dec in reversed(decoders):
+            h = dec(h)
+        return h
+
+    run_epochs(encoders + decoders, full, args.num_epochs, tr, "finetune")
+
+    rec = full(mx.nd.array(va)).asnumpy()
+    mse = float(np.mean((rec - va) ** 2))
+    base = float(np.mean((va - tr.mean(0)) ** 2))
+    print("val mse=%.5f mean-baseline=%.5f" % (mse, base))
+    assert mse < base * 0.2, "autoencoder failed to learn the manifold"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,3 +150,30 @@ def test_actor_critic_cli():
     mean episode length must grow 1.5x over training."""
     out = _run("actor_critic.py", "--num-episodes", "120")
     assert "mean episode length" in out
+
+
+@pytest.mark.slow
+def test_cnn_text_classification_cli():
+    """Kim-CNN over parallel conv widths + max-over-time pooling
+    (reference example/cnn_text_classification parity)."""
+    out = _run("cnn_text_classification.py", "--num-epochs", "5",
+               "--num-examples", "900")
+    assert "final validation accuracy" in out
+
+
+@pytest.mark.slow
+def test_autoencoder_cli():
+    """Greedy layer-wise pretrain + fine-tune stacked AE (reference
+    example/autoencoder parity)."""
+    out = _run("autoencoder.py", "--num-epochs", "8",
+               "--pretrain-epochs", "3", "--num-examples", "1000")
+    assert "val mse" in out
+
+
+@pytest.mark.slow
+def test_bi_lstm_sort_cli():
+    """BidirectionalCell LSTM learns to sort (reference
+    example/bi-lstm-sort parity)."""
+    out = _run("bi_lstm_sort.py", "--num-epochs", "6",
+               "--num-examples", "900")
+    assert "per-position sort accuracy" in out
