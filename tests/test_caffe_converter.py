@@ -1,0 +1,110 @@
+"""Caffe prototxt conversion (tools/caffe_converter.py): the common
+deploy-net subset parses, builds, and runs; weights flow through the
+reference-format checkpoint into Predictor."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LENET_PROTOTXT = """
+name: "LeNet"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 1 dim: 28 dim: 28 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 5 stride: 1 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "relu1" }
+layer { name: "pool1" type: "Pooling" bottom: "relu1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 32 } }
+layer { name: "relu2" type: "ReLU" bottom: "ip1" top: "relu2" }
+layer { name: "ip2" type: "InnerProduct" bottom: "relu2" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+
+def test_caffe_converter_end_to_end(tmp_path):
+    proto = tmp_path / "lenet.prototxt"
+    proto.write_text(LENET_PROTOTXT)
+    rng = np.random.RandomState(0)
+    weights = {
+        "conv1_weight": rng.randn(8, 1, 5, 5).astype(np.float32) * 0.1,
+        "conv1_bias": rng.randn(8).astype(np.float32) * 0.1,
+        "ip1_weight": rng.randn(32, 8 * 12 * 12).astype(np.float32) * 0.01,
+        "ip1_bias": rng.randn(32).astype(np.float32) * 0.1,
+        "ip2_weight": rng.randn(10, 32).astype(np.float32) * 0.1,
+        "ip2_bias": rng.randn(10).astype(np.float32) * 0.1,
+    }
+    wpath = tmp_path / "w.npz"
+    np.savez(wpath, **weights)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "caffe_converter.py"),
+         str(proto), str(tmp_path / "lenet"), "--weights", str(wpath)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert (tmp_path / "lenet-symbol.json").exists()
+    assert (tmp_path / "lenet-0000.params").exists()
+
+    pred = mx.predictor.Predictor(
+        str(tmp_path / "lenet-symbol.json"),
+        str(tmp_path / "lenet-0000.params"),
+        {"data": (2, 1, 28, 28)}, ctx=mx.cpu(0))
+    x = rng.rand(2, 1, 28, 28).astype(np.float32)
+    out = pred.forward(data=x)[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+    # numpy oracle for the conv->relu->pool->fc stack
+    from numpy.lib.stride_tricks import sliding_window_view
+    w, b = weights["conv1_weight"], weights["conv1_bias"]
+    windows = sliding_window_view(x, (5, 5), axis=(2, 3))  # (2,1,24,24,5,5)
+    conv = np.einsum("nchwij,ocij->nohw", windows[:, 0][:, None], w) + \
+        b[None, :, None, None]
+    relu = np.maximum(conv, 0)
+    pool = relu.reshape(2, 8, 12, 2, 12, 2).max((3, 5))
+    h = np.maximum(pool.reshape(2, -1) @ weights["ip1_weight"].T
+                   + weights["ip1_bias"], 0)
+    logits = h @ weights["ip2_weight"].T + weights["ip2_bias"]
+    p_ref = np.exp(logits - logits.max(1, keepdims=True))
+    p_ref /= p_ref.sum(1, keepdims=True)
+    np.testing.assert_allclose(out, p_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_converter_rejects_unknown_layer(tmp_path):
+    from tools.caffe_converter import parse_prototxt, convert
+    net = parse_prototxt("""
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 } } }
+layer { name: "x" type: "FancyLayer" bottom: "data" top: "x" }
+""")
+    with pytest.raises(NotImplementedError, match="FancyLayer"):
+        convert(net)
+
+
+def test_caffe_parser_colon_brace_and_bn_names(tmp_path):
+    from tools.caffe_converter import parse_prototxt, convert
+    # 'field: { ... }' colon-before-brace form must parse identically
+    net = parse_prototxt("""
+layer { name: "data" type: "Input" top: "data"
+  input_param: { shape: { dim: 2 dim: 4 } } }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param: { num_output: 3 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "fc" top: "bn1" }
+layer { name: "sc1" type: "Scale" bottom: "bn1" top: "sc1" }
+layer { name: "prob" type: "Softmax" bottom: "sc1" top: "prob" }
+""")
+    sym, in_shape = convert(net)
+    assert in_shape == (2, 4)
+    args = sym.list_arguments()
+    assert "fc_weight" in args and "bn1_gamma" in args
+    assert "bn1_moving_mean" in sym.list_auxiliary_states()

@@ -1,0 +1,277 @@
+"""Convert a Caffe deploy prototxt (+ optional weights) to a framework
+checkpoint (symbol JSON + .params).
+
+Capability twin of the reference's ``tools/caffe_converter`` for the
+common deployment subset — without needing caffe or protobuf installed:
+the prototxt text format is parsed directly, and weights arrive as an
+``.npz`` (``{layer_name}_weight`` / ``{layer_name}_bias`` arrays, the
+shape caffe stores: conv OIHW, inner-product (out, in) — both match this
+framework's layouts, so no transposes are needed). BatchNorm+Scale pairs
+use the symbol's own names instead: ``{bn_name}_gamma``/``{bn_name}_beta``
+(from the Scale layer's blobs) and ``{bn_name}_moving_mean``/
+``{bn_name}_moving_var`` (the BatchNorm layer's mean/variance blobs,
+divided by its scale factor blob).
+
+Supported layer types: Input/Data, Convolution, InnerProduct, Pooling
+(MAX/AVE, incl. global), ReLU, Sigmoid, TanH, LRN, Dropout, Softmax,
+SoftmaxWithLoss, Concat, Eltwise (SUM/MAX/PROD), Flatten, BatchNorm (+
+the following Scale layer folded in).
+
+  python tools/caffe_converter.py deploy.prototxt out_prefix \
+      [--weights weights.npz]
+
+Writes ``out_prefix-symbol.json`` (+ ``out_prefix-0000.params`` when
+weights are given) — loadable by ``mx.mod.Module`` / ``mx.predictor``.
+"""
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------- prototxt parsing
+
+
+def _tokenize(text):
+    text = re.sub(r"#[^\n]*", "", text)
+    return re.findall(r"[{}]|[A-Za-z_][\w.]*\s*:|\"[^\"]*\"|[^\s{}]+", text)
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into nested dicts; repeated fields
+    become lists."""
+    toks = _tokenize(text)
+    pos = [0]
+
+    def value(tok):
+        if tok.startswith('"'):
+            return tok[1:-1]
+        try:
+            return int(tok)
+        except ValueError:
+            pass
+        try:
+            return float(tok)
+        except ValueError:
+            pass
+        if tok in ("true", "false"):
+            return tok == "true"
+        return tok                       # enum keyword (MAX, SUM, ...)
+
+    def block():
+        out = {}
+        while pos[0] < len(toks):
+            tok = toks[pos[0]]
+            if tok == "}":
+                pos[0] += 1
+                return out
+            if tok.endswith(":"):
+                key = tok[:-1].strip()
+                pos[0] += 1
+                if toks[pos[0]] == "{":   # 'field: { ... }' message form
+                    pos[0] += 1
+                    v = block()
+                else:
+                    v = value(toks[pos[0]])
+                    pos[0] += 1
+            else:
+                key = tok
+                pos[0] += 1
+                if toks[pos[0]] == "{":
+                    pos[0] += 1
+                v = block()
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(v)
+            else:
+                out[key] = v
+        return out
+
+    return block()
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _pair(param, base, default):
+    """kernel_size/stride/pad with optional _h/_w variants."""
+    if param.get(base + "_h") is not None:
+        return (int(param[base + "_h"]), int(param[base + "_w"]))
+    v = param.get(base)
+    if v is None:
+        return (default, default)
+    if isinstance(v, list):
+        v = v[0]
+    return (int(v), int(v))
+
+
+# ------------------------------------------------------- layer conversion
+
+
+def convert(net_def, input_shape=None):
+    """Build an mx Symbol from a parsed deploy net. Returns (symbol,
+    input_shape)."""
+    import mxnet_tpu as mx
+
+    layers = _as_list(net_def.get("layer")) or _as_list(net_def.get("layers"))
+    tops = {}
+    in_shape = input_shape
+
+    if "input" in net_def:          # classic "input:/input_dim:" header
+        name = net_def["input"]
+        name = name[0] if isinstance(name, list) else name
+        tops[name] = mx.sym.Variable("data")
+        dims = [int(d) for d in _as_list(net_def.get("input_dim"))]
+        if not dims and "input_shape" in net_def:
+            dims = [int(d) for d in
+                    _as_list(net_def["input_shape"].get("dim"))]
+        if dims:
+            in_shape = tuple(dims)
+
+    def bottom(l):
+        bots = _as_list(l.get("bottom"))
+        return [tops[b] for b in bots]
+
+    for l in layers:
+        ltype = str(l.get("type"))
+        name = str(l.get("name"))
+        top_names = _as_list(l.get("top")) or [name]
+        if ltype in ("Input", "Data"):
+            tops[top_names[0]] = mx.sym.Variable("data")
+            shp = l.get("input_param", {}).get("shape", {})
+            dims = [int(d) for d in _as_list(shp.get("dim"))]
+            if dims:
+                in_shape = tuple(dims)
+            continue
+        bots = bottom(l)
+        x = bots[0] if bots else None
+        if ltype == "Convolution":
+            p = l.get("convolution_param", {})
+            out = mx.sym.Convolution(
+                x, num_filter=int(p["num_output"]),
+                kernel=_pair(p, "kernel_size", 1),
+                stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True), name=name)
+        elif ltype == "InnerProduct":
+            p = l.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                x, num_hidden=int(p["num_output"]),
+                no_bias=not p.get("bias_term", True), name=name)
+        elif ltype == "Pooling":
+            p = l.get("pooling_param", {})
+            ptype = "avg" if str(p.get("pool", "MAX")) == "AVE" else "max"
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(x, global_pool=True, pool_type=ptype,
+                                     kernel=(1, 1), name=name)
+            else:
+                out = mx.sym.Pooling(
+                    x, kernel=_pair(p, "kernel_size", 1),
+                    stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                    pool_type=ptype,
+                    pooling_convention="full",   # caffe ceil-mode windows
+                    name=name)
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(x, act_type="relu", name=name)
+        elif ltype == "Sigmoid":
+            out = mx.sym.Activation(x, act_type="sigmoid", name=name)
+        elif ltype == "TanH":
+            out = mx.sym.Activation(x, act_type="tanh", name=name)
+        elif ltype == "LRN":
+            p = l.get("lrn_param", {})
+            out = mx.sym.LRN(x, nsize=int(p.get("local_size", 5)),
+                             alpha=float(p.get("alpha", 1.0)),
+                             beta=float(p.get("beta", 0.75)),
+                             knorm=float(p.get("k", 1.0)), name=name)
+        elif ltype == "Dropout":
+            p = l.get("dropout_param", {})
+            out = mx.sym.Dropout(x, p=float(p.get("dropout_ratio", 0.5)),
+                                 name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = mx.sym.SoftmaxOutput(x, name="softmax")
+        elif ltype == "Concat":
+            out = mx.sym.Concat(*bots, name=name)
+        elif ltype == "Eltwise":
+            p = l.get("eltwise_param", {})
+            op = str(p.get("operation", "SUM"))
+            out = bots[0]
+            for b in bots[1:]:
+                out = (out + b if op == "SUM" else
+                       out * b if op == "PROD" else
+                       mx.sym.maximum(out, b))
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(x, name=name)
+        elif ltype == "BatchNorm":
+            p = l.get("batch_norm_param", {})
+            out = mx.sym.BatchNorm(x, eps=float(p.get("eps", 1e-5)),
+                                   use_global_stats=True, fix_gamma=False,
+                                   name=name)
+        elif ltype == "Scale":
+            # caffe pairs BatchNorm with a Scale layer; the BatchNorm
+            # symbol above already carries gamma/beta, so Scale is an
+            # alias of its bottom
+            out = x
+        else:
+            raise NotImplementedError(
+                "caffe layer type %r (layer %r) is not supported by this "
+                "converter" % (ltype, name))
+        tops[top_names[0]] = out
+
+    last = tops[_as_list(layers[-1].get("top"))[0]
+                if layers[-1].get("top") else str(layers[-1]["name"])]
+    return last, in_shape
+
+
+def main():
+    ap = argparse.ArgumentParser(description="caffe prototxt -> mx symbol")
+    ap.add_argument("prototxt")
+    ap.add_argument("out_prefix")
+    ap.add_argument("--weights", default=None,
+                    help=".npz with {layer}_weight/{layer}_bias arrays")
+    args = ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+
+    with open(args.prototxt) as f:
+        net_def = parse_prototxt(f.read())
+    sym, in_shape = convert(net_def)
+    sym.save(args.out_prefix + "-symbol.json")
+    print("wrote %s-symbol.json (input shape %s)"
+          % (args.out_prefix, in_shape))
+
+    if args.weights:
+        blob, skipped = {}, []
+        with np.load(args.weights) as z:
+            arg_names = set(sym.list_arguments())
+            aux_names = set(sym.list_auxiliary_states())
+            for k in z.files:
+                if k in arg_names:
+                    blob["arg:" + k] = mx.nd.array(z[k])
+                elif k in aux_names:
+                    blob["aux:" + k] = mx.nd.array(z[k])
+                else:
+                    skipped.append(k)
+        if skipped:
+            print("  skipped %d arrays with no matching symbol arg: %s"
+                  % (len(skipped), skipped[:6]))
+            print("  (expected names: %s ...)"
+                  % sorted(arg_names | aux_names)[:6])
+        if not blob:
+            ap.error("none of the npz arrays matched the symbol's "
+                     "parameters — check the naming convention in the "
+                     "module docstring")
+        mx.nd.save(args.out_prefix + "-0000.params", blob, format="mxnet")
+        print("wrote %s-0000.params (%d tensors)"
+              % (args.out_prefix, len(blob)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
