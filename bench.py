@@ -86,7 +86,7 @@ def bench_transformer(mx, np, jax, peak):
         mod._fit_step(db)
     drain()
     _note("bench: transformer timing")
-    iters = 8
+    iters = 12
     t0 = time.perf_counter()
     for _ in range(iters):
         mod._fit_step(db)
