@@ -21,6 +21,13 @@ def _suffix_map(names):
     names = list(names)
     pref = _osp.commonprefix(names)
     cut = pref.rfind("_") + 1
+    # suffixes cannot collide within one call: every name shares its
+    # first `cut` characters (cut <= len(commonprefix)), so distinct
+    # names keep distinct suffixes. Cross-map ambiguity (net vs
+    # checkpoint cut at different depths) surfaces as a shape mismatch
+    # in Parameter._load_init; a shape-compatible wrong pairing is not
+    # detectable by name — load by exact names (net.load_params) when
+    # the checkpoint's scoping is untrusted.
     return {n[cut:]: n for n in names}
 
 

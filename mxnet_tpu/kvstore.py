@@ -214,7 +214,16 @@ class DistKVStore(KVStore):
     arrays across servers at the same knob, kvstore_dist.h:292). On this
     rig a collective dispatch costs ~50 ms of RPC, so one-allreduce-per-key
     made Trainer-style training pay seconds per step; fusing makes it one
-    round trip per step.
+    round trip per step — more precisely, one allreduce per dtype per
+    ``MXNET_KVSTORE_BIGARRAY_BOUND``-element chunk of the staged total.
+
+    Staging changes multi-push semantics vs the reference: several pushes
+    to one key between pulls are *summed* and the updater runs once on the
+    sum, whereas the reference's dist server applies the updater per push
+    (kvstore_dist_server.h:164-230) — a stateful optimizer installed via
+    ``set_optimizer`` takes one step instead of N. Identical for the
+    push-once-per-batch pattern every trainer here uses; push-per-
+    accumulation callers should pull between pushes.
 
     ``dist_async`` is accepted but behaves synchronously: XLA collectives
     are bulk-synchronous by construction; there is no stale-push mode.

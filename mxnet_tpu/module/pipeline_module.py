@@ -26,6 +26,15 @@ Stage contract (shapes inferred at ``bind``):
 Limitations (v1): no auxiliary states inside stages (BatchNorm — use
 LayerNorm, the pipeline-era norm anyway) and the per-step RNG key is
 shared across microbatches (affects Dropout only).
+
+Gradient scaling: heads whose loss op normalizes per batch
+(``SoftmaxOutput``/``MakeLoss`` with ``normalization="batch"`` or
+``"valid"``) divide by the *microbatch* row count here, so the sum over
+M microbatches would be M× the equivalent ``Module`` run; ``step``
+folds 1/M back in, making results invariant to ``n_microbatches`` and
+matching ``Module`` at the same ``rescale_grad``. (For ``"valid"``
+with ``use_ignore`` the 1/M correction is exact only when every
+microbatch has the same valid count.)
 """
 from __future__ import annotations
 
@@ -179,6 +188,34 @@ class PipelineModule(object):
                             for k, v in self._dev_params["last"].items()}
         return out
 
+    _LOSS_OPS = ("SoftmaxOutput", "MakeLoss", "LinearRegressionOutput",
+                 "MAERegressionOutput", "LogisticRegressionOutput",
+                 "SVMOutput")
+
+    def _head_normalizes(self):
+        """True when the head stage's loss ops normalize their gradient
+        by row count per call (so per microbatch, not per batch):
+        SoftmaxOutput/MakeLoss with normalization batch/valid (ops/nn.py
+        _softmax_output_bwd). A head mixing normalized and unnormalized
+        loss ops has no single 1/M correction — reject it."""
+        from ..symbol.symbol import _topo_order
+        normed, unnormed = [], []
+        for node in _topo_order(self._stages[-1]._entries):
+            if node.op is None or node.op.name not in self._LOSS_OPS:
+                continue
+            if node.attrs.get("normalization") in ("batch", "valid"):
+                normed.append(node.name)
+            else:
+                unnormed.append(node.name)
+        if normed and unnormed:
+            raise MXNetError(
+                "head stage mixes per-batch-normalized loss ops %s with "
+                "unnormalized ones %s; the GPipe microbatch-accumulation "
+                "correction (1/n_microbatches) cannot apply to both — "
+                "use one normalization mode across the head's losses"
+                % (normed, unnormed))
+        return bool(normed)
+
     # -------------------------------------------------------- optimizer
 
     def init_optimizer(self, optimizer="sgd", optimizer_params=None):
@@ -201,6 +238,10 @@ class PipelineModule(object):
         data_name, label_name = self._data_name, self._label_name
         mesh, axis, n_micro = self._mesh, self._axis, self._n_micro
         remat = self._remat
+        # microbatch-accumulation invariance (see module docstring): a
+        # per-batch-normalized loss head divides by mb rows, not B, so
+        # the accumulated grads carry an extra factor of M — undo it
+        acc_scale = 1.0 / n_micro if self._head_normalizes() else 1.0
 
         def run_sym(fn, extra):
             def call(params, key):
@@ -243,6 +284,9 @@ class PipelineModule(object):
         def step(params, states, inputs, key, lr, t):
             grads, outs = jax.grad(loss_like, has_aux=True)(
                 params, inputs, key)
+            if acc_scale != 1.0:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * acc_scale, grads)
             new_p, new_s = {}, {}
             idx = 0
             for grp in ("first", "body", "last"):

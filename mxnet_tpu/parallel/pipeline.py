@@ -144,10 +144,23 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
                 y, axis, perm=[(i, i + 1) for i in range(n_stages - 1)])
             out_t = t - (n_stages - 1)
             raw_out = tree_mb(xs, jnp.clip(out_t, 0, n_micro - 1))
-            out = y if head_fn is None else head_fn(lparams, y, raw_out)
             take = jnp.logical_and(idx == n_stages - 1,
                                    jnp.logical_and(out_t >= 0,
                                                    out_t < n_micro))
+            if head_fn is None:
+                out = y
+            else:
+                # the head must run ONLY on collected ticks — not just be
+                # masked after the fact. Loss heads (SoftmaxOutput et al.)
+                # have custom vjps that ignore the incoming cotangent, so
+                # a merely-masked head would inject a gradient from every
+                # bubble/garbage tick on every device; lax.cond keeps the
+                # untaken branch out of both forward and backward.
+                out = lax.cond(
+                    take,
+                    lambda args: head_fn(lparams, *args),
+                    lambda args: jnp.zeros(out_sd.shape, out_sd.dtype),
+                    (y, raw_out))
             slot = jnp.clip(out_t, 0, n_micro - 1)
             outs = lax.dynamic_update_index_in_dim(
                 outs,

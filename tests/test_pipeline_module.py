@@ -62,3 +62,56 @@ def test_pipeline_module_rejects_aux_stages():
     mod = mx.mod.PipelineModule([s0, bnb, bnb, head], n_microbatches=2)
     with pytest.raises(mx.base.MXNetError, match="auxiliary"):
         mod.bind(data_shapes=[("data", (4, 6))])
+
+
+def _stages_norm(normalization):
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.FullyConnected(data, num_hidden=8, name="adapt",
+                               flatten=False)
+    body = []
+    for i in range(2):
+        x = mx.sym.Variable("x")
+        h = mx.sym.FullyConnected(x, num_hidden=8, name="b%d" % i,
+                                  flatten=False)
+        body.append(mx.sym.Activation(h, act_type="tanh"))
+    x = mx.sym.Variable("x")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(x, num_hidden=4, name="head"),
+        mx.sym.Variable("softmax_label"), name="softmax",
+        normalization=normalization)
+    return [s0] + body + [head]
+
+
+@pytest.mark.parametrize("normalization", ["null", "batch"])
+def test_pipeline_grads_invariant_to_microbatch_count(normalization):
+    """advisor r4 (medium): --microbatches at fixed batch must not change
+    the effective learning rate (GPipe accumulation invariance)."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(8, 6).astype(np.float32)
+    Y = rng.randint(0, 4, size=(8,)).astype(np.float32)
+    db = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+
+    init_params = {}
+
+    def params_after_step(n_micro):
+        mod = mx.mod.PipelineModule(_stages_norm(normalization),
+                                    n_microbatches=n_micro)
+        mod.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(mx.init.Uniform(0.07))
+        if not init_params:  # share one init across both runs
+            init_params.update({i: {k: v.copy() for k, v in p.items()}
+                                for i, p in mod._params.items()})
+        mod._params = {i: {k: v.copy() for k, v in p.items()}
+                       for i, p in init_params.items()}
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 1.0})
+        mod.fit_step(db)
+        return mod.get_params()
+
+    p2, p8 = params_after_step(2), params_after_step(8)
+    for stage in p2:
+        for name in p2[stage]:
+            np.testing.assert_allclose(
+                p2[stage][name], p8[stage][name], rtol=2e-4, atol=2e-5,
+                err_msg="stage %s param %s" % (stage, name))
