@@ -115,7 +115,10 @@ def main():
     mx.amp.init("bfloat16")   # bf16 MXU compute, fp32 master weights
     _note("bench: resnet bind start")
 
-    sym = resnet.get_symbol(num_classes=1000, num_layers=50)
+    # space-to-depth stem: mathematically identical to the 7x7/2 stem
+    # on the same parameter, ~2 ms/step faster (docs/perf.md round-5
+    # restructuring sweep)
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50, stem="s2d")
     mod = mx.mod.Module(sym, context=ctx)
     mod.bind(data_shapes=[("data", (batch, 3, 224, 224))],
              label_shapes=[("softmax_label", (batch,))])

@@ -115,3 +115,29 @@ def test_pipeline_grads_invariant_to_microbatch_count(normalization):
             np.testing.assert_allclose(
                 p2[stage][name], p8[stage][name], rtol=2e-4, atol=2e-5,
                 err_msg="stage %s param %s" % (stage, name))
+
+    # and against the equivalent non-pipelined Module run (the parity the
+    # module docstring promises): same network as ONE composed symbol,
+    # same init, same rescale_grad convention
+    stages = _stages_norm(normalization)
+    net = stages[0]
+    for s in stages[1:]:
+        net = s(x=net)
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    flat_init = {k: v for p in init_params.values() for k, v in p.items()}
+    mod.init_params(mx.init.Uniform(0.07))
+    mod.set_params(
+        {k: mx.nd.array(v) for k, v in flat_init.items()}, {})
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 1.0})
+    mod.forward_backward(db)
+    mod.update()
+    ref_args, _ = mod.get_params()
+    for stage in p2:
+        for name in p2[stage]:
+            np.testing.assert_allclose(
+                p2[stage][name], ref_args[name].asnumpy(),
+                rtol=2e-3, atol=2e-4,
+                err_msg="vs Module: stage %s param %s" % (stage, name))

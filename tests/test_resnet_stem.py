@@ -1,0 +1,48 @@
+"""Space-to-depth stem transform (models/resnet.py _stem_s2d): the
+TPU ResNet stem restructuring must be mathematically identical to the
+reference's 7x7/2 conv, on the same (F, 3, 7, 7) parameter."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import resnet
+
+
+def test_s2d_stem_matches_7x7_stem():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 64, 64).astype(np.float32)
+    w = (rng.randn(8, 3, 7, 7) * 0.05).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    direct = mx.sym.Convolution(data=data, num_filter=8, kernel=(7, 7),
+                                stride=(2, 2), pad=(3, 3), no_bias=True,
+                                name="conv0")
+    s2d = resnet._stem_s2d(data, 8, 64)
+    feed = {"data": mx.nd.array(x), "conv0_weight": mx.nd.array(w)}
+    a = direct.bind(mx.cpu(0), dict(feed)).forward()[0].asnumpy()
+    b = s2d.bind(mx.cpu(0), dict(feed)).forward()[0].asnumpy()
+    assert a.shape == b.shape == (2, 8, 32, 32)
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_resnet_trains_and_shares_checkpoint_shape():
+    sym = resnet.get_symbol(num_classes=10, num_layers=18,
+                            image_shape="3,64,64", stem="s2d")
+    shapes, _, _ = sym.infer_shape(data=(2, 3, 64, 64), softmax_label=(2,))
+    by_name = dict(zip(sym.list_arguments(), shapes))
+    # the stem parameter keeps the reference's 7x7 shape
+    assert by_name["conv0_weight"] == (64, 3, 7, 7)
+
+    mod = mx.mod.Module(sym, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (2, 3, 64, 64))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(1)
+    db = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(2, 3, 64, 64).astype(np.float32))],
+        label=[mx.nd.array(np.array([1.0, 3.0], np.float32))])
+    mod.forward_backward(db)
+    mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (2, 10) and np.isfinite(out).all()

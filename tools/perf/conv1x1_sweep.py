@@ -1,0 +1,89 @@
+"""Measure 1x1-conv lowering alternatives on the real chip.
+
+ResNet-50's 1x1 convs measured 14 TF/s as `lax.conv_general_dilated`
+(docs/perf.md conv table) while plain matmuls sustain 154-170 TF/s on
+this chip. A 1x1 stride-1 conv IS a matmul over N*H*W rows; this sweep
+times the conv lowering against an explicit transpose+reshape+dot
+lowering, fwd+bwd, bf16, batch 256 (flops counted 3x forward).
+
+Timing discipline (docs/perf.md preamble): in-program lax.scan
+amortization, scalar-read fencing, operands passed as jit args.
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+ITERS = 30
+
+# (H, Cin, Cout) at batch 256, stride 1 — every distinct 1x1 shape in
+# ResNet-50 v2 (both directions of each bottleneck + shortcuts)
+SHAPES = [
+    (56, 64, 64), (56, 64, 256), (56, 256, 64), (56, 256, 128),
+    (28, 128, 512), (28, 512, 256), (28, 256, 1024),
+    (14, 256, 1024), (14, 1024, 512), (14, 512, 2048),
+    (7, 512, 2048), (7, 2048, 512),
+]
+N = 256
+
+def conv_fn(x, w):
+    # framework convention (amp.mxu_operands): bf16 convs rely on the
+    # MXU's native fp32 accumulation; no explicit accumulation request
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+def dot_fn(x, w):
+    n, c, h, _w = x.shape
+    k = w.shape[0]
+    xm = x.transpose(0, 2, 3, 1).reshape(n * h * _w, c)
+    wm = w.reshape(k, c).T
+    y = jnp.dot(xm, wm, preferred_element_type=jnp.float32)
+    y = y.astype(jnp.bfloat16)
+    return y.reshape(n, h, _w, k).transpose(0, 3, 1, 2)
+
+def timed(fn, x, w):
+    def loss(x, w):
+        return jnp.sum(fn(x, w).astype(jnp.float32))
+    g = jax.grad(loss, argnums=(0, 1))
+
+    def body(carry, _):
+        x, w = carry
+        gx, gw = g(x, w)
+        return (x + 1e-6 * gx.astype(x.dtype),
+                w + 1e-6 * gw.astype(w.dtype)), ()
+
+    @jax.jit
+    def run(x, w):
+        (x, w), _ = lax.scan(body, (x, w), None, length=ITERS)
+        return x[0, 0, 0, 0].astype(jnp.float32)
+
+    r = run(x, w); r.block_until_ready(); float(r)  # compile + warm
+    t0 = time.perf_counter()
+    r = run(x, w); float(r)
+    dt = (time.perf_counter() - t0) / ITERS
+    return dt
+
+def main():
+    print("H  Cin->Cout   conv TF/s   dot TF/s   speedup")
+    rows = []
+    for H, ci, co in SHAPES:
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (N, ci, H, H), jnp.bfloat16)
+        w = jax.random.normal(key, (co, ci, 1, 1), jnp.bfloat16) * 0.05
+        fl = 3 * 2.0 * N * H * H * ci * co
+        tc = timed(conv_fn, x, w)
+        td = timed(dot_fn, x, w)
+        rows.append((H, ci, co, fl / tc / 1e12, fl / td / 1e12))
+        print("%3d %5d->%-5d %8.1f %10.1f %8.2fx"
+              % (H, ci, co, fl / tc / 1e12, fl / td / 1e12, tc / td))
+    tot_c = sum(2 * N * h * h * a * b / (r1 * 1e12)
+                for (h, a, b, r1, _) in rows)
+    tot_d = sum(2 * N * h * h * a * b / (r2 * 1e12)
+                for (h, a, b, _, r2) in rows)
+    print("aggregate 1x1 time: conv %.1f ms  dot %.1f ms  (%.2fx)"
+          % (tot_c * 1e3 * 3, tot_d * 1e3 * 3, tot_c / tot_d))
+
+if __name__ == "__main__":
+    main()

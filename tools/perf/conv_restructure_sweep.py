@@ -1,0 +1,151 @@
+"""Round-5 ResNet restructuring sweep (VERDICT r4 item 1).
+
+Three candidate transformations vs the framework's NCHW conv lowering,
+measured fwd+bwd bf16 on the real chip (flops 3x forward):
+
+  a) NHWC 1x1 conv as a pure reshape+dot (no transposes at all)
+  b) NHWC conv lowering (for the 3x3s that would have to switch layout
+     alongside the 1x1s)
+  c) space-to-depth stem: 7x7/2 pad 3 on (N,3,224,224) rewritten as a
+     mathematically identical 4x4/1 valid conv on the 2x2
+     space-to-depth input (Cin 3->12, contraction 147->192)
+
+Timing discipline: lax.scan amortization, scalar-read fence, operands
+as jit args (docs/perf.md preamble).
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+ITERS = 30
+N = 256
+
+def timed(fn, *ops):
+    def loss(*ops):
+        return jnp.sum(fn(*ops).astype(jnp.float32))
+    g = jax.grad(loss, argnums=tuple(range(len(ops))))
+
+    def body(carry, _):
+        gs = g(*carry)
+        return tuple(o + 1e-6 * gg.astype(o.dtype)
+                     for o, gg in zip(carry, gs)), ()
+
+    @jax.jit
+    def run(*ops):
+        out, _ = lax.scan(body, ops, None, length=ITERS)
+        return out[0].ravel()[0].astype(jnp.float32)
+
+    r = run(*ops); r.block_until_ready(); float(r)
+    t0 = time.perf_counter()
+    float(run(*ops))
+    return (time.perf_counter() - t0) / ITERS
+
+def conv(dn):
+    def f(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+            dimension_numbers=dn)
+    return f
+
+def dot_nhwc(x, w):   # x (N,H,W,C), w (C,K)
+    n, h, ww, c = x.shape
+    y = jnp.dot(x.reshape(n * h * ww, c), w)
+    return y.reshape(n, h, ww, -1)
+
+SHAPES = [
+    (56, 64, 64), (56, 64, 256), (56, 256, 64), (56, 256, 128),
+    (28, 128, 512), (28, 512, 256), (14, 256, 1024), (14, 1024, 512),
+    (7, 512, 2048), (7, 2048, 512),
+]
+
+def main():
+    key = jax.random.PRNGKey(0)
+    print("== 1x1 shapes: NCHW conv vs NHWC conv vs NHWC dot (TF/s) ==")
+    agg = [0.0, 0.0, 0.0]
+    for H, ci, co in SHAPES:
+        x1 = jax.random.normal(key, (N, ci, H, H), jnp.bfloat16)
+        w1 = jax.random.normal(key, (co, ci, 1, 1), jnp.bfloat16) * .05
+        x2 = jnp.transpose(x1, (0, 2, 3, 1))
+        w2 = jnp.transpose(w1, (2, 3, 1, 0))  # HWIO
+        wd = w1.reshape(co, ci).T
+        fl = 3 * 2.0 * N * H * H * ci * co
+        t = [timed(conv(("NCHW", "OIHW", "NCHW")), x1, w1),
+             timed(conv(("NHWC", "HWIO", "NHWC")), x2, w2),
+             timed(dot_nhwc, x2, wd)]
+        for i in range(3):
+            agg[i] += t[i]
+        print("%3d %5d->%-5d %8.1f %8.1f %8.1f" %
+              ((H, ci, co) + tuple(fl / tt / 1e12 for tt in t)))
+    print("aggregate 1x1 ms: NCHW-conv %.1f  NHWC-conv %.1f  NHWC-dot %.1f"
+          % tuple(1e3 * a for a in agg))
+
+    print("== 3x3 shapes: NCHW conv vs NHWC conv (TF/s) ==")
+    for H, c, s in [(56, 64, 1), (28, 128, 1), (14, 256, 1), (7, 512, 1),
+                    (56, 128, 2), (28, 256, 2), (14, 512, 2)]:
+        x1 = jax.random.normal(key, (N, c, H, H), jnp.bfloat16)
+        w1 = jax.random.normal(key, (c * (2 if s > 1 else 1), c, 3, 3),
+                               jnp.bfloat16) * .05
+        x2 = jnp.transpose(x1, (0, 2, 3, 1))
+        w2 = jnp.transpose(w1, (2, 3, 1, 0))
+        co = w1.shape[0]
+        Ho = H // s
+        fl = 3 * 2.0 * N * Ho * Ho * c * co * 9
+
+        def c1(x, w):
+            return lax.conv_general_dilated(
+                x, w, window_strides=(s, s), padding=[(1, 1), (1, 1)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+        def c2(x, w):
+            return lax.conv_general_dilated(
+                x, w, window_strides=(s, s), padding=[(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        t1, t2 = timed(c1, x1, w1), timed(c2, x2, w2)
+        print("%3d c%4d s%d  %8.1f %8.1f" %
+              (H, c, s, fl / t1 / 1e12, fl / t2 / 1e12))
+
+    print("== stem: 7x7/2 direct vs space-to-depth(2) ==")
+    x = jax.random.normal(key, (N, 3, 224, 224), jnp.bfloat16)
+    w = jax.random.normal(key, (64, 3, 7, 7), jnp.bfloat16) * .05
+    fl = 3 * 2.0 * N * 112 * 112 * 64 * 3 * 49
+
+    def stem_direct(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def s2d(x):  # (N,C,H,W) -> (N,C*4,H/2,W/2)
+        n, c, h, ww = x.shape
+        x = x.reshape(n, c, h // 2, 2, ww // 2, 2)
+        return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * 4, h // 2,
+                                                     ww // 2)
+
+    def wt(w):  # (K,C,7,7) -> padded (K,C,8,8) -> (K,C*4,4,4)
+        k, c = w.shape[:2]
+        wp = jnp.pad(w, ((0, 0), (0, 0), (0, 1), (0, 1)))
+        wp = wp.reshape(k, c, 4, 2, 4, 2)
+        return wp.transpose(0, 1, 3, 5, 2, 4).reshape(k, c * 4, 4, 4)
+
+    def stem_s2d(x, w):
+        xp = jnp.pad(x, ((0, 0), (0, 0), (3, 3), (3, 3)))
+        xs = s2d(xp)            # (N,12,115,115)
+        return lax.conv_general_dilated(
+            xs, wt(w), window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    # numeric equivalence check first (fp32, CPU-precision caveats ok on TPU)
+    xf = jax.random.normal(key, (2, 3, 32, 32), jnp.float32)
+    wf = jax.random.normal(key, (4, 3, 7, 7), jnp.float32)
+    a = jax.jit(stem_direct)(xf, wf)
+    b = jax.jit(stem_s2d)(xf, wf)
+    err = float(jnp.max(jnp.abs(a - b)))
+    print("s2d equivalence max err:", err)
+    assert err < 1e-3, err
+    t1, t2 = timed(stem_direct, x, w), timed(stem_s2d, x, w)
+    print("stem direct %.1f TF/s (%.2f ms)   s2d %.1f TF/s (%.2f ms)"
+          % (fl / t1 / 1e12, t1 * 1e3, fl / t2 / 1e12, t2 * 1e3))
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Measure achievable HBM bandwidth (read+write) on this chip."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from jax import lax
+
+ITERS = 300
+for mb in (64, 256, 512):
+    n = mb * 1024 * 1024 // 2  # bf16 elements
+    x = jnp.ones((n,), jnp.bfloat16)
+
+    def body(c, _):
+        return c + jnp.bfloat16(1), ()
+
+    @jax.jit
+    def run(x):
+        out, _ = lax.scan(body, x, None, length=ITERS)
+        return out[0].astype(jnp.float32)
+
+    r = run(x); r.block_until_ready(); float(r)
+    t0 = time.perf_counter(); float(run(x))
+    dt = (time.perf_counter() - t0) / ITERS
+    bw = 2 * mb / 1024 / dt  # read + write, GiB/s
+    print("array %4d MiB: %.2f ms/pass  %.0f GiB/s (r+w)" % (mb, dt * 1e3, bw))

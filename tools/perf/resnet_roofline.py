@@ -1,0 +1,83 @@
+"""Per-layer roofline for ResNet-50 v2 training, batch 256, bf16.
+
+For every conv: t_lower_bound = max(flops / PEAK_FLOPS, bytes / HBM_BW).
+Train counts 3x forward flops and ~3x forward bytes (fwd, dx, dw each
+stream the activation-sized arrays once). Elementwise columns add the
+BN/ReLU/residual traffic at 2 bytes/elem/pass, assuming perfect fusion
+into one read+write per tensor per pass.
+
+No chip needed -- pure arithmetic; constants from tools/perf/hbm_bw.py
+(measured ~500-540 GB/s achievable) and the 197 TF/s bf16 peak.
+"""
+B = 256
+PEAK = 197e12
+BWS = [537e9, 819e9]   # measured-achievable and nominal
+
+# (name, H_in, Cin, Cout, k, stride, count)
+LAYERS = [
+    ("stem 7x7/2",      224, 3,    64,   7, 2, 1),
+    ("s1 c1 64->64",     56, 64,   64,   1, 1, 1),
+    ("s1 c2 3x3",        56, 64,   64,   3, 1, 3),
+    ("s1 c3 64->256",    56, 64,   256,  1, 1, 3),
+    ("s1 sc 64->256",    56, 64,   256,  1, 1, 1),
+    ("s1 c1 256->64",    56, 256,  64,   1, 1, 2),
+    ("s2 c1 256->128",   56, 256,  128,  1, 1, 1),
+    ("s2 c2 3x3/2",      56, 128,  128,  3, 2, 1),
+    ("s2 c2 3x3",        28, 128,  128,  3, 1, 3),
+    ("s2 c3 128->512",   28, 128,  512,  1, 1, 4),
+    ("s2 sc 256->512/2", 56, 256,  512,  1, 2, 1),
+    ("s2 c1 512->128",   28, 512,  128,  1, 1, 3),
+    ("s3 c1 512->256",   28, 512,  256,  1, 1, 1),
+    ("s3 c2 3x3/2",      28, 256,  256,  3, 2, 1),
+    ("s3 c2 3x3",        14, 256,  256,  3, 1, 5),
+    ("s3 c3 256->1024",  14, 256,  1024, 1, 1, 6),
+    ("s3 sc 512->1024/2",28, 512,  1024, 1, 2, 1),
+    ("s3 c1 1024->256",  14, 1024, 256,  1, 1, 5),
+    ("s4 c1 1024->512",  14, 1024, 512,  1, 1, 1),
+    ("s4 c2 3x3/2",      14, 512,  512,  3, 2, 1),
+    ("s4 c2 3x3",         7, 512,  512,  3, 1, 2),
+    ("s4 c3 512->2048",   7, 512,  2048, 1, 1, 3),
+    ("s4 sc 1024->2048/2",14,1024, 2048, 1, 2, 1),
+    ("s4 c1 2048->512",   7, 2048, 512,  1, 1, 2),
+    ("fc 2048->1000",     1, 2048, 1000, 1, 1, 1),
+]
+
+def main():
+    tot_f = 0.0
+    tot_t = {bw: [0.0, 0.0] for bw in BWS}  # conv-only, conv+elemwise
+    print("%-20s %9s %9s  %s" % ("layer", "GF(train)", "int(F/B)",
+                                 "  ".join("t@%dGB/s(ms)" % (b/1e9)
+                                           for b in BWS)))
+    for name, H, ci, co, k, s, cnt in LAYERS:
+        Ho = H // s
+        F = 2.0 * B * Ho * Ho * co * ci * k * k * cnt      # fwd flops
+        bytes_f = 2.0 * cnt * (B * H * H * ci + B * Ho * Ho * co
+                               + co * ci * k * k)
+        Ftr, Btr = 3 * F, 3 * bytes_f
+        # elementwise: BN (read y, write y) + ReLU fused + residual adds:
+        # ~2 extra passes over y fwd, ~4 bwd (dy reads, BN stats)
+        Bel = Btr + 6 * 2.0 * cnt * B * Ho * Ho * co
+        line = "%-20s %9.1f %9.1f" % (name, Ftr / 1e9, Ftr / Btr)
+        for bw in BWS:
+            t1 = max(Ftr / PEAK, Btr / bw)
+            t2 = max(Ftr / PEAK, Bel / bw)
+            tot_t[bw][0] += t1
+            tot_t[bw][1] += t2
+            line += "  %6.2f/%6.2f" % (t1 * 1e3, t2 * 1e3)
+        tot_f += Ftr
+        print(line)
+    print()
+    print("total train GFLOPs: %.0f  (%.1f GF/img fwd)"
+          % (tot_f / 1e9, tot_f / 3 / B / 1e9))
+    for bw in BWS:
+        for j, tag in enumerate(("conv-only", "conv+elemwise")):
+            t = tot_t[bw][j]
+            print("roofline @%3d GB/s %-14s: %6.1f ms/step  %6.0f img/s  "
+                  "MFU ceiling %4.1f%%"
+                  % (bw / 1e9, tag, t * 1e3, B / t,
+                     100 * tot_f / PEAK / t))
+    meas_ms = 110.8  # BENCH_r04: 2310 img/s
+    print("measured (BENCH_r04): 110.8 ms/step, 2310 img/s, 28.8%% MFU")
+
+if __name__ == "__main__":
+    main()
