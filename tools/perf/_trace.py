@@ -1,0 +1,46 @@
+"""Shared XLA trace-event aggregation for the perf profiling scripts."""
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def aggregate_trace(logdir, steps):
+    """Aggregate a jax.profiler trace dir by op name.
+
+    Returns rows sorted by descending device time:
+    ``[(op, ms_per_step, calls_per_step, GBps), ...]``.
+    """
+    files = glob.glob(logdir + "/**/*.trace.json.gz", recursive=True)
+    assert files, "no trace written under %s:\n%s" % (
+        logdir, os.popen("find %s -type f" % logdir).read())
+    ev = json.load(gzip.open(files[0]))["traceEvents"]
+    agg = collections.defaultdict(lambda: [0.0, 0.0, 0])
+    for e in ev:
+        if e.get("ph") != "X" or "args" not in e:
+            continue
+        a = e["args"]
+        if "device_duration_ps" not in a:
+            continue
+        dur = float(a["device_duration_ps"]) / 1e9  # ms
+        op = a.get("tf_op", e.get("name", "?"))
+        key = op.split("/")[-1] if "/" in op else op
+        agg[key][0] += dur
+        agg[key][1] += float(a.get("bytes_accessed", 0))
+        agg[key][2] += 1
+    rows = []
+    for k, (d, by, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        d_step = d / steps
+        bw = by / steps / (d_step / 1e3) / 1e9 if d_step > 0 else 0.0
+        rows.append((k, d_step, n // steps, bw))
+    return rows
+
+
+def print_rows(rows, limit=30):
+    print("%-52s %9s %6s %9s" % ("op", "ms/step", "n", "GB/s"))
+    tot = 0.0
+    for k, d_step, n, bw in rows[:limit]:
+        tot += d_step
+        print("%-52s %9.3f %6d %9.0f" % (k[:52], d_step, n, bw))
+    print("TOTAL (top rows): %.1f ms/step" % tot)

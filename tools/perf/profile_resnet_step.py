@@ -3,7 +3,7 @@
 Aggregates the XLA trace by op category to show where the 110 ms goes:
 conv MXU work vs BN/elementwise HBM traffic vs overhead.
 """
-import sys, json, gzip, glob, os, collections
+import sys, os
 sys.path.insert(0, "/root/repo")
 import numpy as np
 import jax
@@ -39,32 +39,7 @@ with jax.profiler.trace(logdir):
         mod._fit_step(db)
     drain()
 
-# aggregate by tf_op from the trace-event json
-files = glob.glob(logdir + "/**/*.trace.json.gz", recursive=True)
-assert files, os.popen("find %s -type f" % logdir).read()
-ev = json.load(gzip.open(files[0]))["traceEvents"]
-agg = collections.defaultdict(lambda: [0.0, 0.0, 0])  # dur_ms, bytes, n
-total = 0.0
-for e in ev:
-    if e.get("ph") != "X" or "args" not in e:
-        continue
-    a = e["args"]
-    if "device_duration_ps" not in a and "tf_op" not in a:
-        continue
-    dur = float(a.get("device_duration_ps", e.get("dur", 0) * 1e6)) / 1e9  # ms
-    op = a.get("tf_op", e.get("name", "?"))
-    # collapse to coarse category
-    name = e.get("name", "")
-    key = op.split("/")[-1] if "/" in op else op
-    agg[key][0] += dur
-    agg[key][1] += float(a.get("bytes_accessed", 0))
-    agg[key][2] += 1
-    total += dur
-rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
-print("%-46s %9s %8s %6s %9s" % ("op", "ms/step", "%", "n", "GB/s"))
-for k, (d, by, n) in rows[:40]:
-    d6 = d / 6
-    bw = by / 6 / (d6 / 1e3) / 1e9 if d6 > 0 else 0
-    print("%-46s %9.3f %7.1f%% %6d %9.0f" % (k[:46], d6, 100 * d / total,
-                                             n // 6, bw))
-print("TOTAL device time: %.1f ms/step over 6 steps" % (total / 6))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _trace import aggregate_trace, print_rows
+
+print_rows(aggregate_trace(logdir, 6), limit=40)

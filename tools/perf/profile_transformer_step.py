@@ -1,0 +1,43 @@
+"""Per-op profile of the L12 transformer fused train step (bench config):
+where do the flash kernels' 30 ms go vs the 13 ms standalone ideal?"""
+import sys, os
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+
+L, D, H, T, V, B = 12, 2048, 16, 1024, 32000, 8
+mx.amp.init("bfloat16")   # bench.py parity: bf16 compute, f32 master
+sym = transformer.get_symbol(vocab_size=V, num_layers=L, d_model=D,
+                             n_heads=H, seq_len=T, attention="flash")
+mod = mx.mod.Module(sym, context=mx.tpu(0))
+mod.bind(data_shapes=[("data", (B, T))],
+         label_shapes=[("softmax_label", (B, T))])
+mod.init_params(mx.init.Xavier())
+mod.init_optimizer(optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.01})
+rng = np.random.RandomState(0)
+db = mx.io.DataBatch(
+    data=[mx.nd.array(rng.randint(0, V, (B, T)).astype(np.float32), ctx=mx.tpu(0))],
+    label=[mx.nd.array(rng.randint(0, V, (B, T)).astype(np.float32), ctx=mx.tpu(0))])
+
+def drain():
+    return float(np.asarray(mod._exec.arg_dict["lm_head_weight"].data[0, 0]))
+
+for _ in range(2):
+    mod._fit_step(db)
+drain()
+
+logdir = "/tmp/tf_prof"
+os.system("rm -rf " + logdir)
+STEPS = 4
+with jax.profiler.trace(logdir):
+    for _ in range(STEPS):
+        mod._fit_step(db)
+    drain()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _trace import aggregate_trace, print_rows
+
+print_rows(aggregate_trace(logdir, STEPS))
