@@ -63,17 +63,29 @@ def main():
     p.add_argument("--num-batches", type=int, default=30)
     p.add_argument("--num-epochs", type=int, default=3)
     p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="gpipe (autodiff, all-fwd-then-all-bwd) or 1f1b "
+                        "(hand-scheduled, O(stages) activation memory)")
+    p.add_argument("--ffn-widths", default=None,
+                   help="comma list of per-stage FFN widths (unequal "
+                        "stages -> heterogeneous pipeline), e.g. "
+                        "'256,128,128,64'")
     args = p.parse_args()
 
     import mxnet_tpu as mx
     from mxnet_tpu.models import transformer
 
+    d_ff = None
+    if args.ffn_widths:
+        d_ff = [int(w) for w in args.ffn_widths.split(",")]
     stages = transformer.get_pipeline_stages(
         vocab_size=VOCAB, n_stages=args.stages,
         layers_per_stage=args.layers_per_stage, d_model=args.d_model,
-        n_heads=args.n_heads, seq_len=args.seq_len,
+        n_heads=args.n_heads, seq_len=args.seq_len, d_ff=d_ff,
         moe_experts=args.experts)
-    mod = mx.mod.PipelineModule(stages, n_microbatches=args.microbatches)
+    mod = mx.mod.PipelineModule(stages, n_microbatches=args.microbatches,
+                                schedule=args.schedule)
     mod.bind(data_shapes=[("data", (args.batch_size, args.seq_len))],
              label_shapes=[("softmax_label",
                             (args.batch_size, args.seq_len))])

@@ -120,8 +120,19 @@ def get_pipeline_stages(vocab_size=32000, n_stages=2, layers_per_stage=1,
     matters). The head applies the final LayerNorm + lm head +
     per-token SoftmaxOutput, so gradients follow Module.fit's loss-op
     semantics per microbatch.
+
+    ``d_ff`` may be a list of ``n_stages`` per-stage FFN widths — the
+    stages then have *unequal* parameter shapes, which PipelineModule
+    runs in its heterogeneous mode (per-stage param trees).
     """
     d_ff = d_ff or 4 * d_model
+    if isinstance(d_ff, (list, tuple)):
+        if len(d_ff) != n_stages:
+            raise ValueError("d_ff list must have n_stages=%d entries"
+                             % n_stages)
+        stage_ff = list(d_ff)
+    else:
+        stage_ff = [d_ff] * n_stages
     T = seq_len
 
     data = sym.Variable("data")
@@ -134,6 +145,7 @@ def get_pipeline_stages(vocab_size=32000, n_stages=2, layers_per_stage=1,
     embed = sym.broadcast_add(tok, sym.reshape(pos, (1, T, d_model)))
 
     def body_stage(si):
+        d_ff = stage_ff[si]
         x = sym.Variable("x")
         for li in range(layers_per_stage):
             name = "s%d_layer%d" % (si, li)

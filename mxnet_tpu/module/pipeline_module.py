@@ -1,31 +1,44 @@
-"""PipelineModule: Module-style training with GPipe pipeline stages.
+"""PipelineModule: Module-style training with pipelined stages.
 
 The user surface for pipeline parallelism (the reference's inter-layer
 ``group2ctx`` story, src/executor/graph_executor.cc:279-393, made a
 first-class schedule): the model arrives as a list of stage Symbols, one
 per device along a ``pipe`` mesh axis, and the whole schedule — embed
-adapter, N repeated stages, loss head, microbatch accumulation, backward,
+adapter, N body stages, loss head, microbatch accumulation, backward,
 optimizer update — compiles into ONE jitted SPMD program built on
-``parallel.pipeline_apply``.
+``parallel.pipeline_apply`` (GPipe) or ``parallel.pipeline_1f1b``
+(one-forward-one-backward).
 
 Stage contract (shapes inferred at ``bind``):
 
 * ``stages[0]`` — input adapter: consumes the ``data`` variable, emits
   the pipeline "wire" (e.g. token embedding). Runs replicated.
-* ``stages[1:-1]`` — the repeated body: one free variable named ``x``
-  (the wire), wire-shaped output, and **identical parameter structure**
-  across stages (equal blocks per stage, the usual pipeline layout);
-  their stacked parameters are sharded over the pipe axis.
+* ``stages[1:-1]`` — the body: one free variable named ``x`` (the
+  wire) and wire-shaped output. Bodies with **identical parameter
+  structure** run on the fast path (stacked parameters sharded over
+  the pipe axis); heterogeneous bodies (unequal shapes/structures) are
+  supported too — each device runs its own stage branch and the
+  per-stage parameter trees ride replicated (ragged trees cannot
+  shard), which pipelines activations but not parameter memory.
 * ``stages[-1]`` — the head: free variable ``x`` plus any bound label
   variables (e.g. ``softmax_label``); typically ends in a loss op
   (SoftmaxOutput). Runs replicated. Its output is treated like Module's
   forward outputs: backward seeds it with ones, so loss ops' non-vjp
   backward semantics (p - onehot) apply per microbatch and gradients
-  accumulate across microbatches — GPipe gradient accumulation.
+  accumulate across microbatches.
 
-Limitations (v1): no auxiliary states inside stages (BatchNorm — use
-LayerNorm, the pipeline-era norm anyway) and the per-step RNG key is
-shared across microbatches (affects Dropout only).
+Schedules (``schedule=``):
+
+* ``"gpipe"`` (default) — all-forward-then-all-backward via jax
+  autodiff of the forward scan; activation residuals for all M
+  microbatches stay live. Restrictions: no auxiliary states in stages
+  (BatchNorm), one RNG key shared across microbatches (Dropout).
+* ``"1f1b"`` — hand-scheduled one-forward-one-backward lattice
+  (PipeDream-flush class): activation memory is O(n_stages) instead of
+  O(M), stages MAY hold auxiliary states (BatchNorm running stats
+  advance once per microbatch), and the RNG key is folded with the
+  microbatch index (per-microbatch Dropout, replayed exactly in the
+  backward recompute). Parameters ride replicated (see above).
 
 Gradient scaling: heads whose loss op normalizes per batch
 (``SoftmaxOutput``/``MakeLoss`` with ``normalization="batch"`` or
@@ -48,13 +61,15 @@ from .. import ndarray as nd_mod
 from .. import optimizer as opt_mod
 from ..executor import graph_function
 from ..parallel.mesh import make_mesh
-from ..parallel.pipeline import pipeline_apply, stack_stage_params
+from ..parallel.pipeline import (pipeline_apply, pipeline_1f1b,
+                                 stack_stage_params)
 
 __all__ = ["PipelineModule"]
 
 
 class PipelineModule(object):
-    """Train a stage-split model with a GPipe schedule over a pipe axis.
+    """Train a stage-split model with a pipelined schedule over a pipe
+    axis.
 
     Parameters
     ----------
@@ -68,22 +83,31 @@ class PipelineModule(object):
         devices.
     axis : str
         Pipe mesh-axis name.
+    schedule : "gpipe" or "1f1b"
+        See the module docstring.
     remat : bool
-        Recompute stage activations in backward (GPipe memory trade).
+        GPipe only: recompute stage activations in backward
+        (``jax.checkpoint``). 1F1B always recomputes from saved stage
+        inputs — that is its design.
     """
 
     def __init__(self, stages, n_microbatches, mesh=None, axis="pipe",
-                 remat=False, logger=logging):
+                 schedule="gpipe", remat=False, logger=logging):
         if len(stages) < 3:
             raise ValueError("need >= 3 stages (adapter, body..., head)")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError("schedule must be 'gpipe' or '1f1b', got %r"
+                             % (schedule,))
         self._stages = list(stages)
         self._n_micro = int(n_microbatches)
         self._axis = axis
+        self._schedule = schedule
         self._remat = bool(remat)
         self._mesh = mesh
         self.logger = logger
         self._bound = False
         self._params: Dict[str, Dict[str, object]] = {}
+        self._aux: Dict[int, Dict[str, object]] = {}
         self._optimizer = None
         self._step_fn = None
 
@@ -114,11 +138,17 @@ class PipelineModule(object):
 
         # per-stage shape inference walks the wire through the stages
         self._stage_args: List[Dict[str, tuple]] = []
+        self._stage_aux_shapes: List[Dict[str, tuple]] = []
         for i, sym in enumerate(self._stages):
-            if sym.list_auxiliary_states():
+            aux_names = sym.list_auxiliary_states()
+            body_stage = 0 < i < len(self._stages) - 1
+            if aux_names and not (body_stage and self._schedule == "1f1b"):
                 raise MXNetError(
-                    "PipelineModule stages cannot hold auxiliary states "
-                    "(stage %d has %s)" % (i, sym.list_auxiliary_states()))
+                    "auxiliary states (%s in stage %d) are only supported "
+                    "in body stages under schedule='1f1b' (the adapter "
+                    "and head run replicated on every device, where "
+                    "per-microbatch running stats would diverge)"
+                    % (aux_names, i))
             feed = {}
             if i == 0:
                 feed[self._data_name] = mb_data
@@ -127,29 +157,39 @@ class PipelineModule(object):
             if i == len(self._stages) - 1 and self._label_name and \
                     self._label_name in sym.list_arguments():
                 feed[self._label_name] = mb_label
-            arg_shapes, out_shapes, _ = sym.infer_shape(**feed)
+            arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**feed)
             args = {n: tuple(s) for n, s in
                     zip(sym.list_arguments(), arg_shapes)
                     if n not in feed}
             self._stage_args.append(args)
+            self._stage_aux_shapes.append(
+                {n: tuple(s) for n, s in zip(aux_names, aux_shapes)})
             if i < len(self._stages) - 1:
                 self._wire_shape = tuple(out_shapes[0])
             else:
                 self._out_shape = tuple(out_shapes[0])
 
-        # body stages may use per-stage names (b1_*, b2_*, ...): they are
-        # matched positionally in sorted-name order against stage 1, and
-        # their stacked pytree is keyed by stage 1's names (the body fn)
+        # body stages may use per-stage names (b1_*, b2_*, ...): matched
+        # positionally in sorted-name order. Equal per-stage shapes ->
+        # the stacked, param-sharded fast path (gpipe); unequal ->
+        # heterogeneous mode (per-stage trees, replicated).
         body = self._stage_args[1:-1]
         canon = sorted(body[0])
         self._body_order = [sorted(b) for b in body]
+        self._hetero = False
         for i, names in enumerate(self._body_order):
             shapes = [body[i][n] for n in names]
             want = [body[0][n] for n in canon]
-            if shapes != want:
-                raise ValueError(
-                    "body stage %d parameter shapes %s do not line up "
-                    "with stage 1's %s" % (i + 1, shapes, want))
+            if len(shapes) != len(want) or shapes != want:
+                self._hetero = True
+        # aux states must line up too for the stacked layout
+        baux = self._stage_aux_shapes[1:-1]
+        self._aux_order = [sorted(a) for a in baux]
+        for i, names in enumerate(self._aux_order):
+            shapes = [baux[i][n] for n in names]
+            want = [baux[0][n] for n in self._aux_order[0]]
+            if len(shapes) != len(want) or shapes != want:
+                self._hetero = True
 
         self._fns = [graph_function(s) for s in self._stages]
         self._bound = True
@@ -169,6 +209,12 @@ class PipelineModule(object):
                 initializer(init_mod.InitDesc(name, {}), arr)
                 stage_params[name] = np.asarray(arr.asnumpy())
             self._params[i] = stage_params
+            stage_aux = {}
+            for name, shape in self._stage_aux_shapes[i].items():
+                arr = nd_mod.zeros(shape, dtype=np.float32)
+                initializer(init_mod.InitDesc(name, {}), arr)
+                stage_aux[name] = np.asarray(arr.asnumpy())
+            self._aux[i] = stage_aux
 
     def get_params(self):
         """Per-stage parameter dicts, reflecting training: after
@@ -179,14 +225,33 @@ class PipelineModule(object):
         n_stage = len(self._stages)
         out = {0: {k: np.asarray(v)
                    for k, v in self._dev_params["first"].items()}}
-        canon = sorted(self._stage_args[1])
-        for i in range(1, n_stage - 1):
-            names = self._body_order[i - 1]
-            out[i] = {n: np.asarray(self._dev_params["body"][c][i - 1])
-                      for c, n in zip(canon, names)}
+        body = self._dev_params["body"]
+        if isinstance(body, tuple):        # heterogeneous (tuple) layout
+            for i in range(1, n_stage - 1):
+                out[i] = {n: np.asarray(v)
+                          for n, v in body[i - 1].items()}
+        else:                              # stacked layout
+            canon = sorted(self._stage_args[1])
+            for i in range(1, n_stage - 1):
+                names = self._body_order[i - 1]
+                out[i] = {n: np.asarray(body[c][i - 1])
+                          for c, n in zip(canon, names)}
         out[n_stage - 1] = {k: np.asarray(v)
                             for k, v in self._dev_params["last"].items()}
         return out
+
+    def get_aux(self):
+        """Per-stage auxiliary states (1f1b schedule only)."""
+        dev = getattr(self, "_dev_aux", None)
+        if isinstance(dev, tuple):            # heterogeneous layout
+            return {i + 1: {k: np.asarray(v) for k, v in t.items()}
+                    for i, t in enumerate(dev)}
+        if isinstance(dev, dict) and dev:     # stacked layout
+            acanon = sorted(self._stage_aux_shapes[1])
+            return {i + 1: {n: np.asarray(dev[c][i])
+                            for c, n in zip(acanon, self._aux_order[i])}
+                    for i in range(len(self._aux_order))}
+        return {i: dict(a) for i, a in self._aux.items() if a}
 
     _LOSS_OPS = ("SoftmaxOutput", "MakeLoss", "LinearRegressionOutput",
                  "MAERegressionOutput", "LogisticRegressionOutput",
@@ -227,8 +292,7 @@ class PipelineModule(object):
         if isinstance(optimizer, str):
             optimizer_params = dict(optimizer_params or {})
             # per-example gradient scaling, same convention as
-            # Module.init_optimizer (module.py:345-351): head grads are
-            # p-onehot per microbatch, summed over microbatches
+            # Module.init_optimizer (module.py:345-351)
             optimizer_params.setdefault("rescale_grad", 1.0 / self._batch)
             optimizer = opt_mod.create(optimizer, **optimizer_params)
         self._optimizer = optimizer
@@ -238,88 +302,173 @@ class PipelineModule(object):
         data_name, label_name = self._data_name, self._label_name
         mesh, axis, n_micro = self._mesh, self._axis, self._n_micro
         remat = self._remat
-        # microbatch-accumulation invariance (see module docstring): a
-        # per-batch-normalized loss head divides by mb rows, not B, so
-        # the accumulated grads carry an extra factor of M — undo it
+        # microbatch-accumulation invariance (see module docstring)
         acc_scale = 1.0 / n_micro if self._head_normalizes() else 1.0
+        opt = self._optimizer
+        tuple_mode = self._hetero
 
-        def run_sym(fn, extra):
-            def call(params, key):
-                outs, _ = fn({**params, **extra}, {}, key, True)
-                return outs[0]
-            return call
-
-        def first_fn(p, raw):
-            outs, _ = fns[0]({**p, data_name: raw[data_name]}, {},
-                             p["__key__"], True)
+        # ---- stage closures over graph_function. The key rides as a
+        # "__key__" leaf in the gpipe path (3-ary calls) and as an
+        # explicit trailing argument in the 1f1b path.
+        def first_fn(p, raw, *k):
+            kk = k[0] if k else p["__key__"]
+            feed = {kk2: v for kk2, v in p.items() if kk2 != "__key__"}
+            feed[data_name] = raw[data_name]
+            outs, _ = fns[0](feed, {}, kk, True)
             return outs[0]
 
-        def stage_fn(p, x):
-            outs, _ = fns[1]({**{k: v for k, v in p.items()
-                                 if k != "__key__"}, "x": x}, {},
-                             p["__key__"], True)
-            return outs[0]
-
-        def last_fn(p, y, raw):
-            feed = {k: v for k, v in p.items() if k != "__key__"}
+        def last_fn(p, y, raw, *k):
+            kk = k[0] if k else p["__key__"]
+            feed = {kk2: v for kk2, v in p.items() if kk2 != "__key__"}
             feed["x"] = y
             if label_name is not None:
                 feed[label_name] = raw[label_name]
-            outs, _ = fns[n_stage - 1](feed, {}, p["__key__"], True)
+            outs, _ = fns[n_stage - 1](feed, {}, kk, True)
             return outs[0]
 
-        def loss_like(params, inputs, key):
-            fp = dict(params["first"]); fp["__key__"] = key
-            lp = dict(params["last"]); lp["__key__"] = key
-            sp = dict(params["body"]); sp["__key__"] = \
-                jnp.broadcast_to(key, (n_stage - 2,) + key.shape)
-            outs = pipeline_apply(
-                stage_fn, sp, inputs, mesh=mesh, axis=axis,
-                first_fn=first_fn, first_params=fp,
-                last_fn=last_fn, last_params=lp, remat=remat)
-            return jnp.sum(outs.astype(jnp.float32)), outs
+        def body_fn_gpipe(i):
+            def sfn(p, x):
+                feed = {kk: v for kk, v in p.items() if kk != "__key__"}
+                feed["x"] = x
+                outs, _ = fns[i]({**feed}, {}, p["__key__"], True)
+                return outs[0]
+            return sfn
 
-        opt = self._optimizer
+        def body_fn_1f1b(i):
+            def sfn(p, a, x, kk):
+                feed = dict(p)
+                feed["x"] = x
+                outs, new_aux = fns[i](feed, a, kk, True)
+                return outs[0], new_aux
+            return sfn
 
-        def step(params, states, inputs, key, lr, t):
-            grads, outs = jax.grad(loss_like, has_aux=True)(
-                params, inputs, key)
-            if acc_scale != 1.0:
-                grads = jax.tree_util.tree_map(
-                    lambda g: g * acc_scale, grads)
-            new_p, new_s = {}, {}
-            idx = 0
-            for grp in ("first", "body", "last"):
-                gp, gs = {}, {}
-                for name in sorted(params[grp]):
-                    w, s = opt.raw_update(
-                        idx, params[grp][name], grads[grp][name],
-                        states[grp][name], lr=lr, t=t)
-                    gp[name], gs[name] = w, s
-                    idx += 1
-                new_p[grp], new_s[grp] = gp, gs
-            return outs, new_p, new_s
+        def body_fn_1f1b_stacked(p, a, x, kk):
+            """Single fn over stage-1's graph with stage-1 (canon) names;
+            all body graphs agree structurally in the stacked case."""
+            outs, new_aux = fns[1]({**p, "x": x}, a, kk, True)
+            return outs[0], new_aux
 
-        self._step_jit = jax.jit(step, donate_argnums=(0, 1))
-
-        # assemble device param pytrees: body stacked under stage 1's
-        # names (positional match in sorted order), first/last flat
-        import jax.numpy as jnp
-        canon = sorted(self._stage_args[1])
-        body_trees = []
-        for i in range(1, n_stage - 1):
-            names = self._body_order[i - 1]
-            body_trees.append({c: jnp.asarray(self._params[i][n])
-                               for c, n in zip(canon, names)})
+        # ---- assemble device param pytrees
+        if tuple_mode:
+            body_trees = tuple(
+                {n: jnp.asarray(self._params[i][n])
+                 for n in self._stage_args[i]}
+                for i in range(1, n_stage - 1))
+            body_aux = tuple(
+                {n: jnp.asarray(self._aux[i][n])
+                 for n in self._stage_aux_shapes[i]}
+                for i in range(1, n_stage - 1))
+        else:
+            canon = sorted(self._stage_args[1])
+            acanon = sorted(self._stage_aux_shapes[1])
+            per_stage, per_aux = [], []
+            for i in range(1, n_stage - 1):
+                names = self._body_order[i - 1]
+                per_stage.append({c: jnp.asarray(self._params[i][n])
+                                  for c, n in zip(canon, names)})
+                per_aux.append({c: jnp.asarray(self._aux[i][n])
+                                for c, n in zip(acanon,
+                                                self._aux_order[i - 1])})
+            body_trees = stack_stage_params(per_stage)
+            body_aux = stack_stage_params(per_aux) if acanon else \
+                ({} if self._schedule == "1f1b" else None)
         self._dev_params = {
             "first": {k: jnp.asarray(v)
                       for k, v in self._params[0].items()},
-            "body": stack_stage_params(body_trees),
+            "body": body_trees,
             "last": {k: jnp.asarray(v)
                      for k, v in self._params[n_stage - 1].items()},
         }
+        self._dev_aux = body_aux if self._schedule == "1f1b" else None
 
-        # optimizer state per leaf (momentum etc.); SGD w/o momentum -> None
+        # ---- the jitted step
+        if self._schedule == "1f1b":
+            if tuple_mode:
+                stage_fns = [body_fn_1f1b(i)
+                             for i in range(1, n_stage - 1)]
+            else:
+                # homogeneous: single fn + stacked P(axis)-sharded params
+                stage_fns = body_fn_1f1b_stacked
+
+            def step(params, aux, states, inputs, key, lr, t):
+                res = pipeline_1f1b(
+                    stage_fns, params["body"], inputs, mesh=mesh,
+                    axis=axis, first_fn=first_fn,
+                    first_params=params["first"], last_fn=last_fn,
+                    last_params=params["last"], key=key, stage_aux=aux)
+                outs, grads, new_aux = res
+                gtree = {"first": grads["first"],
+                         "body": grads["stages"],
+                         "last": grads["last"]}
+                if acc_scale != 1.0:
+                    gtree = jax.tree_util.tree_map(
+                        lambda g: g * acc_scale, gtree)
+                new_p, new_s = _apply_opt(params, gtree, states, lr, t)
+                return outs, new_p, new_s, new_aux
+
+            self._step_jit = jax.jit(step, donate_argnums=(0, 1, 2))
+        else:
+            if tuple_mode:
+                stage_arg = [body_fn_gpipe(i)
+                             for i in range(1, n_stage - 1)]
+            else:
+                stage_arg = body_fn_gpipe(1)
+
+            def loss_like(params, inputs, key):
+                # distinct key per stage (identically-built stages would
+                # otherwise drop identical dropout coordinates); the
+                # microbatch key is still shared under gpipe — a
+                # documented limitation, lifted by schedule="1f1b"
+                fp = dict(params["first"])
+                fp["__key__"] = jax.random.fold_in(key, n_stage - 2)
+                lp = dict(params["last"])
+                lp["__key__"] = jax.random.fold_in(key, n_stage - 1)
+                if tuple_mode:
+                    sp = tuple(
+                        dict(tr, __key__=jax.random.fold_in(key, i))
+                        for i, tr in enumerate(params["body"]))
+                else:
+                    sp = dict(params["body"])
+                    sp["__key__"] = jax.vmap(
+                        lambda i: jax.random.fold_in(key, i))(
+                        jnp.arange(n_stage - 2))
+                outs = pipeline_apply(
+                    stage_arg, sp, inputs, mesh=mesh, axis=axis,
+                    first_fn=first_fn, first_params=fp,
+                    last_fn=last_fn, last_params=lp, remat=remat)
+                return jnp.sum(outs.astype(jnp.float32)), outs
+
+            def step(params, states, inputs, key, lr, t):
+                grads, outs = jax.grad(loss_like, has_aux=True)(
+                    params, inputs, key)
+                if acc_scale != 1.0:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g * acc_scale, grads)
+                new_p, new_s = _apply_opt(params, grads, states, lr, t)
+                return outs, new_p, new_s
+
+            self._step_jit = jax.jit(step, donate_argnums=(0, 1))
+
+        def _apply_opt(params, grads, states, lr, t):
+            """One optimizer update per parameter leaf, deterministic
+            leaf order across the {first, body, last} groups. Each
+            parameter's optimizer state may itself be a subtree
+            (momentum array, adam (m, v), or None) — flatten_up_to
+            groups it per parameter."""
+            flat_p, tdef = jax.tree_util.tree_flatten(params)
+            flat_g = tdef.flatten_up_to(grads)
+            flat_s = tdef.flatten_up_to(states)
+            new_p, new_s = [], []
+            for idx, (w, g, s) in enumerate(zip(flat_p, flat_g, flat_s)):
+                w2, s2 = opt.raw_update(idx, w, g.astype(w.dtype), s,
+                                        lr=lr, t=t)
+                new_p.append(w2)
+                new_s.append(s2)
+            return (jax.tree_util.tree_unflatten(tdef, new_p),
+                    jax.tree_util.tree_unflatten(tdef, new_s))
+
+        # optimizer state per leaf (momentum etc.); SGD w/o momentum ->
+        # None-shaped zeros so the state tree matches the param tree
         def state_for(w):
             s = opt.create_state(0, nd_mod.array(np.zeros(w.shape,
                                                           np.float32)))
@@ -361,10 +510,15 @@ class PipelineModule(object):
             lr = self._optimizer.lr_scheduler(self._t)
         else:
             lr = self._optimizer.lr
-        outs, self._dev_params, self._dev_states = self._step_jit(
-            self._dev_params, self._dev_states, inputs, key,
-            jnp.asarray(lr, jnp.float32),
-            jnp.asarray(self._t, jnp.int32))
+        lr = jnp.asarray(lr, jnp.float32)
+        t = jnp.asarray(self._t, jnp.int32)
+        if self._schedule == "1f1b":
+            outs, self._dev_params, self._dev_states, self._dev_aux = \
+                self._step_jit(self._dev_params, self._dev_aux,
+                               self._dev_states, inputs, key, lr, t)
+        else:
+            outs, self._dev_params, self._dev_states = self._step_jit(
+                self._dev_params, self._dev_states, inputs, key, lr, t)
         return outs
 
     def fit(self, train_iter, num_epoch=1, eval_metric=None):

@@ -136,6 +136,18 @@ def test_pipeline_moe_transformer_cli():
 
 
 @pytest.mark.slow
+def test_pipeline_transformer_1f1b_hetero_cli():
+    """1F1B schedule + unequal per-stage FFN widths (heterogeneous
+    pipeline, VERDICT r4 #3) through the same CLI."""
+    out = _run("pipeline_moe_transformer.py", "--stages", "2",
+               "--experts", "0", "--schedule", "1f1b",
+               "--ffn-widths", "128,64", "--num-epochs", "2",
+               "--num-batches", "10", "--d-model", "32",
+               "--seq-len", "16")
+    assert "final-ppl=" in out
+
+
+@pytest.mark.slow
 def test_super_resolution_cli():
     """ESPCN-style sub-pixel upscaling (reference
     example/gluon/super_resolution.py parity): PSNR must beat nearest."""

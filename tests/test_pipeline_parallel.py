@@ -169,3 +169,51 @@ def test_pipeline_remat_matches_plain():
     np.testing.assert_allclose(np.asarray(g_remat["w"]),
                                np.asarray(g_plain["w"]),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_1f1b_stacked_and_tuple_match_sequential():
+    """pipeline_1f1b's two parameter layouts (stacked/P(axis)-sharded
+    for homogeneous stages, per-stage tuple for heterogeneous) must both
+    reproduce the sequential model's gradients exactly."""
+    from mxnet_tpu.parallel.pipeline import pipeline_1f1b
+
+    D = 8
+    rng = np.random.RandomState(0)
+    Ws = [jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3)
+          for _ in range(N_STAGES)]
+    We = jnp.asarray(rng.randn(6, D).astype(np.float32) * 0.3)
+    Wh = jnp.asarray(rng.randn(D, 4).astype(np.float32) * 0.3)
+    X = jnp.asarray(rng.randn(16, 6).astype(np.float32))
+    L = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    mesh = make_mesh({"pipe": N_STAGES})
+    inputs = {"data": X.reshape(8, 2, 6), "label": L.reshape(8, 2, 4)}
+    first = lambda p, raw, k: raw["data"] @ p["we"]
+    last = lambda p, y, raw, k: jnp.sum((y @ p["wh"] - raw["label"]) ** 2,
+                                        axis=-1)
+    fp, lp = {"we": We}, {"wh": Wh}
+    sfn = lambda p, x, k: jnp.tanh(x @ p["w"])
+
+    o1, g1 = pipeline_1f1b(sfn, stack_stage_params([{"w": w} for w in Ws]),
+                           inputs, mesh=mesh, axis="pipe", first_fn=first,
+                           first_params=fp, last_fn=last, last_params=lp)
+    o2, g2 = pipeline_1f1b([sfn] * N_STAGES, tuple({"w": w} for w in Ws),
+                           inputs, mesh=mesh, axis="pipe", first_fn=first,
+                           first_params=fp, last_fn=last, last_params=lp)
+
+    def ref_loss(ps):
+        fp_, ws, lp_ = ps
+        h = X @ fp_["we"]
+        for w in ws:
+            h = jnp.tanh(h @ w)
+        return jnp.sum(jnp.sum((h @ lp_["wh"] - L) ** 2, axis=-1))
+
+    gr = jax.grad(ref_loss)((fp, tuple(Ws), lp))
+    for k in range(N_STAGES):
+        np.testing.assert_allclose(np.asarray(g1["stages"]["w"][k]),
+                                   np.asarray(gr[1][k]), rtol=5e-3,
+                                   atol=5e-4)
+        np.testing.assert_allclose(np.asarray(g2["stages"][k]["w"]),
+                                   np.asarray(gr[1][k]), rtol=5e-3,
+                                   atol=5e-4)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-5, atol=1e-5)
