@@ -76,9 +76,18 @@ def main():
     parser = argparse.ArgumentParser(description="train cifar10-style")
     fit_mod.add_fit_args(parser)
     parser.add_argument("--num-examples", type=int, default=2000)
+    parser.add_argument("--seed", type=int, default=42)
+    # the lr decay (reference train_cifar10.py default
+    # --lr-step-epochs) is what makes the FINAL epoch the converged
+    # one: flat lr=0.1 SGD oscillates epoch-to-epoch on the tiny val
+    # set (NIGHTLY_r04 run-2 flake), decayed SGD settles
     parser.set_defaults(network="resnet", num_epochs=8, lr=0.1,
-                        batch_size=100, disp_batches=10)
+                        lr_step_epochs="4,6", batch_size=100,
+                        disp_batches=10)
     args = parser.parse_args()
+    np.random.seed(args.seed)     # initializers draw from the global RNG
+    import mxnet_tpu as mx
+    mx.random.seed(args.seed)
 
     from mxnet_tpu.models import resnet
     # resnet-8 for 32x32 inputs (reference train_cifar10 uses the
@@ -93,23 +102,15 @@ def main():
             cache["iters"] = data_loader(a, kv)
         return cache["iters"]
 
-    best = {"acc": 0.0}
-
-    def _track(param):
-        # SGD at this lr oscillates epoch-to-epoch on the tiny val set;
-        # the convergence gate is the best epoch, not the last one
-        for name, value in param.eval_metric.get_name_value():
-            if name == "accuracy":
-                best["acc"] = max(best["acc"], value)
-
-    mod = fit_mod.fit(args, net, loader, eval_end_callback=_track)
+    mod = fit_mod.fit(args, net, loader)
     _, val = cache["iters"]
     val.reset()
     score = mod.score(val, "acc")
-    best["acc"] = max(best["acc"], score[0][1])
-    print("final validation accuracy: %.4f (best %.4f)"
-          % (score[0][1], best["acc"]))
-    assert best["acc"] > 0.85, "failed to learn the synthetic textures"
+    # FINAL-epoch accuracy is the contract (reference
+    # tests/python/train: convergence, not a mid-run peak); the seeded
+    # run with lr decay makes it deterministic
+    print("final validation accuracy: %.4f" % score[0][1])
+    assert score[0][1] > 0.85, "failed to learn the synthetic textures"
     return 0
 
 

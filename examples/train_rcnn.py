@@ -10,6 +10,12 @@ with precomputed proposals); evaluation asserts ROI classification
 accuracy, and an RPN-style ``Proposal``-op pass shows the detection ops
 compose.
 
+Backend constraint: ``proposal_target`` uses the host-callback CustomOp
+path (arbitrary numpy at graph-execution time), which remote-tunnel TPU
+plugins reject — on such rigs this example runs on the CPU backend.
+Hot-loop custom ops should implement ``forward_traced`` instead
+(docs/new_op.md §1b) to stay device-resident.
+
 Run:  python examples/train_rcnn.py --num-epochs 25
 """
 import argparse

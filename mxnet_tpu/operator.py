@@ -17,8 +17,17 @@ cost model: a host round-trip per call, so use it for glue, not hot loops.
 
 Backend note: host callbacks need a runtime with send/recv support —
 standard CPU/GPU/TPU PJRT runtimes have it; remote-tunnel plugins (e.g.
-the experimental axon proxy) may not, in which case custom ops run on
-the CPU backend only.
+the experimental axon proxy) reject them outright, in which case
+callback-based custom ops run on the CPU backend only.
+
+Device-resident fast path: a ``CustomOpProp`` that overrides
+``forward_traced`` (and optionally ``backward_traced``) with
+jax-traceable code compiles INTO the XLA program — TPU-resident, fused,
+no host round trip, works on every backend including callback-less
+tunnels. Gradients default to jax autodiff of the traced forward. This
+is the path hot-loop custom ops should take; the callback path remains
+for arbitrary host Python (reference parity:
+src/operator/custom/custom.cc:380-405 kLocal semantics).
 """
 from __future__ import annotations
 
@@ -89,6 +98,28 @@ class CustomOpProp(object):
 
     def need_top_grad(self) -> bool:
         return self.need_top_grad_
+
+    def forward_traced(self, in_data, is_train):
+        """OPTIONAL device-resident fast path: return a tuple of outputs
+        computed with jax-traceable code (jnp/lax/Pallas) over the input
+        jax arrays. Overriding this method commits the op to the traced
+        path: it compiles INTO the XLA program — runs on the TPU, fuses
+        with its neighbors, and needs no host round-trip (the callback
+        path is host-executed and rejected outright by remote-tunnel
+        plugins; see docs/new_op.md). Gradients come from jax autodiff
+        of this function unless :meth:`backward_traced` is also
+        overridden. Leave it un-overridden to use the host-callback
+        ``create_operator`` path."""
+        raise NotImplementedError
+
+    def backward_traced(self, out_grad, in_data, out_data):
+        """OPTIONAL custom gradient for :meth:`forward_traced`: return a
+        tuple of input cotangents from jax-traceable code (one per
+        input; cotangents for integer inputs are discarded). With
+        ``need_top_grad=False`` the incoming ``out_grad`` may be ignored
+        (mxnet loss-op semantics). Leave it un-overridden to use jax
+        autodiff of ``forward_traced``."""
+        raise NotImplementedError
 
     def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
         raise NotImplementedError
@@ -162,6 +193,47 @@ def _custom_impl(arrays, op_type, attrs, is_train):
                       for s, t in zip(oshapes, otypes))
     in_avals = tuple(jax.ShapeDtypeStruct(s, _np_dtype(a.dtype))
                      for s, a in zip(in_shapes, arrays))
+
+    # device-resident fast path: jax-traceable forward (and optionally
+    # backward) compile into the program — no host callback at all
+    if type(prop).forward_traced is not CustomOpProp.forward_traced:
+        def fwd(*xs):
+            outs = prop.forward_traced(list(xs), is_train)
+            return tuple(outs)
+
+        if type(prop).backward_traced is CustomOpProp.backward_traced:
+            outs = fwd(*arrays)     # plain autodiff handles the grads
+            return outs if len(outs) != 1 else outs[0]
+
+        import jax.numpy as jnp
+
+        def cot_for(g, x):
+            # custom_vjp demands float0 cotangents for integer primals
+            if not jnp.issubdtype(jnp.result_type(x.dtype), jnp.inexact):
+                return np.zeros(np.shape(x), jax.dtypes.float0)
+            return g.astype(x.dtype)
+
+        @jax.custom_vjp
+        def run_t(*xs):
+            return fwd(*xs)
+
+        def run_t_fwd(*xs):
+            outs = fwd(*xs)
+            return outs, (xs, outs)
+
+        def run_t_bwd(res, cts):
+            xs, outs = res
+            gs = prop.backward_traced(list(cts), list(xs), list(outs))
+            if gs is None or len(gs) != len(xs):
+                raise ValueError(
+                    "backward_traced of %r must return one cotangent "
+                    "per input (%d); leave it un-overridden to use "
+                    "autodiff" % (op_type, len(xs)))
+            return tuple(cot_for(g, x) for g, x in zip(gs, xs))
+
+        run_t.defvjp(run_t_fwd, run_t_bwd)
+        outs = run_t(*arrays)
+        return outs if len(outs) != 1 else outs[0]
     # one operator instance per call site, like the reference's per-executor
     # instance (custom-inl.h CustomOperator); it lives across executions and
     # may carry state

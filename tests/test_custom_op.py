@@ -196,3 +196,110 @@ def test_legacy_ndarray_op_scale():
     np.testing.assert_allclose(out, 3.0)
     ex.backward(mx.nd.array(np.full((2, 3), 2.0, np.float32)))
     np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 6.0)
+
+
+# ---------------------------------------------------------------- traced
+
+
+@mx.operator.register("traced_gelu")
+class TracedGeluProp(mx.operator.CustomOpProp):
+    """Device-resident custom op: jax-traceable forward, autodiff grads —
+    compiles into the program, no host callback (docs/new_op.md)."""
+
+    def forward_traced(self, in_data, is_train):
+        import jax
+        (x,) = in_data
+        return (jax.nn.gelu(x),)
+
+
+@mx.operator.register("traced_softmax_loss")
+class TracedSoftmaxLossProp(mx.operator.CustomOpProp):
+    """Traced forward + traced custom backward with loss-op semantics
+    (ignores the incoming cotangent, like SoftmaxOutput)."""
+
+    def __init__(self):
+        super(TracedSoftmaxLossProp, self).__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], [in_shape[0][0]]], [in_shape[0]], []
+
+    def forward_traced(self, in_data, is_train):
+        import jax
+        x, _ = in_data
+        return (jax.nn.softmax(x, axis=1),)
+
+    def backward_traced(self, out_grad, in_data, out_data):
+        import jax
+        import jax.numpy as jnp
+        x, label = in_data
+        p = out_data[0]
+        oh = jax.nn.one_hot(label.astype(jnp.int32), x.shape[1],
+                            dtype=p.dtype)
+        return (p - oh, jnp.zeros_like(label))
+
+
+def test_traced_custom_forward_and_autodiff():
+    x = mx.nd.array(np.array([[-2.0, 0.0, 3.0]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="traced_gelu")
+        loss = mx.nd.sum(y)
+    loss.backward()
+    import jax
+    import jax.numpy as jnp
+    want = np.asarray(jax.nn.gelu(jnp.asarray(x.asnumpy())))
+    np.testing.assert_allclose(y.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    gref = np.asarray(jax.grad(
+        lambda v: jnp.sum(jax.nn.gelu(v)))(jnp.asarray(x.asnumpy())))
+    np.testing.assert_allclose(x.grad.asnumpy(), gref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_traced_custom_loss_module_fit():
+    """The traced custom loss trains through the fused Module step —
+    the path that must work on callback-less backends."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="tanh")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    out = mx.sym.Custom(h, label, op_type="traced_softmax_loss",
+                        name="loss")
+    mod = mx.mod.Module(out, context=mx.cpu(0), data_names=["data"],
+                        label_names=["label"])
+    mod.bind(data_shapes=[("data", (12, 6))],
+             label_shapes=[("label", (12,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    rng = np.random.RandomState(0)
+    X = rng.randn(12, 6).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32) + (X[:, 1] > 0).astype(
+        np.float32)
+    db = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+    for _ in range(200):
+        mod.forward_backward(db)
+        mod.update()
+    mod.forward(db, is_train=False)
+    p = mod.get_outputs()[0].asnumpy()
+    assert (p.argmax(1) == Y).mean() >= 0.9
+
+
+def test_traced_custom_loss_int_labels():
+    """Integer-dtype inputs need float0 cotangents in the traced custom
+    backward (review r5 finding)."""
+    import jax.numpy as jnp
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    lab = mx.nd.array(np.array([0, 1, 2, 1], np.int32))
+    x.attach_grad()
+    with mx.autograd.record():
+        p = mx.nd.Custom(x, lab, op_type="traced_softmax_loss")
+        loss = mx.nd.sum(p)
+    loss.backward()
+    g = x.grad.asnumpy()
+    pn = np.asarray(p.asnumpy())
+    oh = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    np.testing.assert_allclose(g, pn - oh, rtol=1e-5, atol=1e-6)
