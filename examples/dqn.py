@@ -1,0 +1,153 @@
+"""Deep Q-Network on cart-pole: replay buffer + target network.
+
+Capability twin of the reference's
+``example/reinforcement-learning/dqn``: off-policy Q-learning with the
+three DQN ingredients — an experience replay buffer sampled uniformly,
+a frozen target network synced every N steps, and epsilon-greedy
+exploration with decay. The environment is the same self-contained
+cart-pole physics used by ``actor_critic.py`` (no gym egress).
+
+Gate: mean evaluation episode length over the last greedy rollouts must
+beat the random policy by >2.5x.
+
+Run:  python examples/dqn.py --num-episodes 100
+"""
+import argparse
+import collections
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class CartPole(object):
+    """Classic cart-pole dynamics (Barto-Sutton-Anderson constants)."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        f = 10.0 if action == 1 else -10.0
+        ct, st = np.cos(th), np.sin(th)
+        tmp = (f + 0.05 * thd * thd * st) / 1.1
+        tha = (9.8 * st - ct * tmp) / (0.5 * (4.0 / 3 - 0.1 * ct * ct / 1.1))
+        xa = tmp - 0.05 * tha * ct / 1.1
+        self.s = np.array([x + 0.02 * xd, xd + 0.02 * xa,
+                           th + 0.02 * thd, thd + 0.02 * tha], np.float32)
+        done = bool(abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.21)
+        return self.s.copy(), (0.0 if done else 1.0), done
+
+
+def rollout_greedy(env, qfn, max_steps=300):
+    s = env.reset()
+    for t in range(max_steps):
+        a = int(np.argmax(qfn(s)))
+        s, r, done = env.step(a)
+        if done:
+            return t + 1
+    return max_steps
+
+
+def main():
+    p = argparse.ArgumentParser(description="DQN cart-pole")
+    p.add_argument("--num-episodes", type=int, default=100)
+    p.add_argument("--buffer", type=int, default=10000)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--gamma", type=float, default=0.99)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--target-sync", type=int, default=200,
+                   help="steps between target-network syncs")
+    p.add_argument("--seed", type=int, default=3)
+    args = p.parse_args()
+    np.random.seed(args.seed)
+    random.seed(args.seed)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    def make_net():
+        net = nn.Sequential()
+        net.add(nn.Dense(64, activation="tanh"),
+                nn.Dense(64, activation="tanh"), nn.Dense(2))
+        return net
+
+    qnet, target = make_net(), make_net()
+    qnet.initialize(mx.init.Xavier())
+    target.initialize(mx.init.Xavier())
+    # materialize deferred-init params before the first sync
+    dummy = mx.nd.array(np.zeros((1, 4), np.float32))
+    qnet(dummy)
+    target(dummy)
+
+    def sync_target():
+        # gluon's global instance counters give the two nets different
+        # prefixes (dense0../dense3..); pair parameters positionally
+        src = list(qnet.collect_params().values())
+        dst = list(target.collect_params().values())
+        for sp, dp in zip(src, dst):
+            dp.set_data(sp.data())
+
+    sync_target()
+    trainer = gluon.Trainer(qnet.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    buf = collections.deque(maxlen=args.buffer)
+    env = CartPole(seed=args.seed)
+    qfn = lambda s: qnet(mx.nd.array(s[None])).asnumpy()[0]
+
+    baseline = np.mean([rollout_greedy(env, lambda s: np.random.rand(2))
+                        for _ in range(20)])
+    eps, steps = 1.0, 0
+    lengths = []
+    for ep in range(args.num_episodes):
+        s = env.reset()
+        for t in range(200):
+            eps = max(0.05, eps * 0.999)
+            a = random.randrange(2) if random.random() < eps \
+                else int(np.argmax(qfn(s)))
+            s2, r, done = env.step(a)
+            buf.append((s, a, r, s2, done))
+            s = s2
+            steps += 1
+            if len(buf) >= args.batch_size and steps % 4 == 0:
+                batch = random.sample(buf, args.batch_size)
+                bs = mx.nd.array(np.stack([b[0] for b in batch]))
+                ba = np.array([b[1] for b in batch], np.int64)
+                br = np.array([b[2] for b in batch], np.float32)
+                bs2 = mx.nd.array(np.stack([b[3] for b in batch]))
+                bd = np.array([b[4] for b in batch], np.float32)
+                # frozen-target bootstrap: max_a' Q_target(s', a')
+                q2 = target(bs2).asnumpy().max(axis=1)
+                y = mx.nd.array(br + args.gamma * q2 * (1 - bd))
+                with mx.autograd.record():
+                    q = qnet(bs)
+                    qa = mx.nd.pick(q, mx.nd.array(ba), axis=1)
+                    loss = mx.nd.mean(mx.nd.square(qa - y))
+                loss.backward()
+                trainer.step(1)
+            if steps % args.target_sync == 0:
+                sync_target()
+            if done:
+                break
+        lengths.append(t + 1)
+        if (ep + 1) % 25 == 0:
+            print("Episode[%d] mean-length(last 25)=%.1f eps=%.2f"
+                  % (ep + 1, np.mean(lengths[-25:]), eps), flush=True)
+
+    final = np.mean([rollout_greedy(env, qfn) for _ in range(10)])
+    print("greedy eval: %.1f steps (random baseline %.1f)"
+          % (final, baseline))
+    assert final > 2.5 * baseline, "DQN did not learn to balance"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

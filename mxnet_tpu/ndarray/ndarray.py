@@ -226,7 +226,17 @@ class NDArray:
         sharding = getattr(self._data, "sharding", None)
         if isinstance(key, slice) and key == slice(None):
             if np.isscalar(val):
-                self._data = jnp.full_like(self._data, val)
+                # full_like materializes a fresh constant, which eager
+                # jax places on the DEFAULT device, not the input's —
+                # on rigs whose default backend differs from the
+                # array's context this silently migrated every
+                # scalar-filled parameter (bias/gamma/beta inits) and
+                # produced mixed-device graphs; pin it back
+                new = jnp.full_like(self._data, val)
+                if sharding is not None and \
+                        getattr(new, "sharding", None) != sharding:
+                    new = jax.device_put(new, sharding)
+                self._data = new
             else:
                 # .copy() so a full-slice assign never aliases the source
                 # buffer (donated-buffer safety, see copyto)

@@ -198,10 +198,29 @@ def _custom_impl(arrays, op_type, attrs, is_train):
     # backward) compile into the program — no host callback at all
     if type(prop).forward_traced is not CustomOpProp.forward_traced:
         def fwd(*xs):
-            outs = prop.forward_traced(list(xs), is_train)
-            return tuple(outs)
+            outs = tuple(prop.forward_traced(list(xs), is_train))
+            if len(outs) != len(out_avals) or any(
+                    tuple(o.shape) != a.shape or o.dtype != a.dtype
+                    for o, a in zip(outs, out_avals)):
+                raise ValueError(
+                    "forward_traced of %r returned %s, but infer_shape/"
+                    "infer_type declare %s" % (
+                        op_type,
+                        [(tuple(o.shape), str(o.dtype)) for o in outs],
+                        [(a.shape, str(np.dtype(a.dtype)))
+                         for a in out_avals]))
+            return outs
 
         if type(prop).backward_traced is CustomOpProp.backward_traced:
+            if not prop.need_top_grad():
+                # the callback path would DROP the incoming cotangent
+                # (loss-op semantics); plain autodiff multiplies by it —
+                # a ported loss op would silently train on ~zero grads
+                raise ValueError(
+                    "custom op %r declares need_top_grad=False (loss-op "
+                    "semantics) but overrides only forward_traced; "
+                    "autodiff would consume the head gradient it promises "
+                    "to ignore — override backward_traced too" % op_type)
             outs = fwd(*arrays)     # plain autodiff handles the grads
             return outs if len(outs) != 1 else outs[0]
 

@@ -108,3 +108,128 @@ layer { name: "prob" type: "Softmax" bottom: "sc1" top: "prob" }
     args = sym.list_arguments()
     assert "fc_weight" in args and "bn1_gamma" in args
     assert "bn1_moving_mean" in sym.list_auxiliary_states()
+
+
+# ------------------------------------------------ binary caffemodel reader
+
+
+def _enc_varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _enc_field(fno, wt, payload):
+    key = _enc_varint((fno << 3) | wt)
+    if wt == 0:
+        return key + _enc_varint(payload)
+    return key + _enc_varint(len(payload)) + payload
+
+
+def _enc_blob(arr, legacy=False, packed=True):
+    import numpy as np
+    arr = np.asarray(arr, np.float32)
+    msg = b""
+    if legacy:
+        dims = ([1] * (4 - arr.ndim)) + list(arr.shape)
+        for fno, d in zip((1, 2, 3, 4), dims):
+            msg += _enc_field(fno, 0, int(d))
+    else:
+        shape_msg = b"".join(_enc_varint(d) for d in arr.shape)
+        msg += _enc_field(7, 2, _enc_field(1, 2, shape_msg))
+    if packed:
+        msg += _enc_field(5, 2, arr.ravel().astype("<f4").tobytes())
+    else:
+        for v in arr.ravel():
+            msg += _enc_varint((5 << 3) | 5) + \
+                np.float32(v).astype("<f4").tobytes()
+    return msg
+
+
+def _enc_layer(name, blobs, v1=False, **blob_kw):
+    nf, bf = (4, 6) if v1 else (1, 7)
+    msg = _enc_field(nf, 2, name.encode())
+    for b in blobs:
+        msg += _enc_field(bf, 2, _enc_blob(b, **blob_kw))
+    return _enc_field(2 if v1 else 100, 2, msg)
+
+
+def test_caffemodel_reader_roundtrip(tmp_path):
+    """Full binary path: hand-encoded NetParameter (independent of the
+    reader) -> converter -> Module forward matches numpy (reference:
+    tools/caffe_converter/convert_model.py reads the same message)."""
+    import subprocess
+    import sys as _sys
+    rng = np.random.RandomState(0)
+    W = rng.randn(3, 2, 3, 3).astype(np.float32) * 0.2
+    bW = rng.randn(3).astype(np.float32)
+    mean = rng.rand(3).astype(np.float32)
+    var = (rng.rand(3) + 0.5).astype(np.float32)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+    fc = rng.randn(4, 3 * 4 * 4).astype(np.float32) * 0.1
+    fcb = rng.randn(4).astype(np.float32)
+
+    prototxt = """
+name: "tiny"
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 4
+input_dim: 4
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 3 kernel_size: 3 pad: 1 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "conv1" top: "bn1" }
+layer { name: "sc1" type: "Scale" bottom: "bn1" top: "sc1"
+  scale_param { bias_term: true } }
+layer { name: "fc1" type: "InnerProduct" bottom: "sc1" top: "fc1"
+  inner_product_param { num_output: 4 } }
+"""
+    sf = 2.0   # caffe scale-factor blob: stored stats are sf * true stats
+    model = b"".join([
+        _enc_layer("conv1", [W, bW]),
+        _enc_layer("bn1", [mean * sf, var * sf, np.array([sf])],
+                   legacy=True, packed=False),   # legacy dims + unpacked
+        _enc_layer("sc1", [gamma, beta], v1=True),  # V1 'layers' form
+        _enc_layer("fc1", [fc.reshape(1, 1, 4, 3 * 4 * 4), fcb],
+                   legacy=True),
+    ])
+    proto_path = tmp_path / "tiny.prototxt"
+    proto_path.write_text(prototxt)
+    model_path = tmp_path / "tiny.caffemodel"
+    model_path.write_bytes(model)
+    prefix = str(tmp_path / "out")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(ROOT, "tools", "caffe_converter.py"),
+         str(proto_path), prefix, "--caffemodel", str(model_path)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "parsed 8 parameter tensors" in proc.stdout
+
+    import mxnet_tpu as mx
+    sym = mx.sym.load(prefix + "-symbol.json")
+    params = mx.nd.load(prefix + "-0000.params")
+    args = {k[4:]: v for k, v in params.items() if k.startswith("arg:")}
+    aux = {k[4:]: v for k, v in params.items() if k.startswith("aux:")}
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    args["data"] = mx.nd.array(x)
+    ex = sym.bind(mx.cpu(0), args, aux_states=aux)
+    got = ex.forward(is_train=False)[0].asnumpy()
+
+    # numpy reference with the TRUE (unscaled) statistics
+    import numpy as np2
+    from numpy.lib.stride_tricks import sliding_window_view
+    xp = np2.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    win = sliding_window_view(xp, (3, 3), axis=(2, 3))  # (1,2,4,4,3,3)
+    conv = np2.einsum("nchwij,ocij->nohw", win, W) + bW[None, :, None, None]
+    bnv = (conv - mean[None, :, None, None]) / np2.sqrt(
+        var[None, :, None, None] + 1e-5)
+    bnv = bnv * gamma[None, :, None, None] + beta[None, :, None, None]
+    want = bnv.reshape(1, -1) @ fc.T + fcb
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)

@@ -189,3 +189,39 @@ def test_bi_lstm_sort_cli():
     out = _run("bi_lstm_sort.py", "--num-epochs", "6",
                "--num-examples", "900")
     assert "per-position sort accuracy" in out
+
+
+@pytest.mark.slow
+def test_lstm_crf_cli():
+    """BiLSTM-CRF: dynamic-programming loss (forward algorithm) +
+    Viterbi decode; the transition matrix must learn the tag grammar."""
+    out = _run("lstm_crf.py", "--num-epochs", "6", "--num-examples",
+               "200")
+    assert "tag accuracy" in out
+
+
+@pytest.mark.slow
+def test_neural_style_cli():
+    """Gradient-wrt-input optimization (Gatys-style): Gram statistics
+    must move to the style target while content survives."""
+    out = _run("neural_style.py", "--num-steps", "120")
+    assert "style loss" in out
+
+
+@pytest.mark.nightly
+@pytest.mark.slow
+def test_dqn_cli():
+    """DQN: replay buffer + frozen target network + epsilon decay on
+    cart-pole; greedy eval must beat random by >2.5x."""
+    out = _run("dqn.py", "--num-episodes", "80")
+    assert "greedy eval" in out
+
+
+@pytest.mark.nightly
+@pytest.mark.slow
+def test_tree_lstm_cli():
+    """Child-sum Tree-LSTM: recursive composition over expression trees
+    with topology-bucketed batching; must beat the bag-of-leaves
+    baseline decisively."""
+    out = _run("tree_lstm.py")
+    assert "eval accuracy" in out

@@ -147,3 +147,24 @@ def test_ndarray_onehot_encode():
     out = mx.nd.zeros((2, 3))
     mx.nd.onehot_encode(idx, out)
     np.testing.assert_allclose(out.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_scalar_fill_keeps_placement():
+    """Full-slice scalar assignment must stay on the array's device:
+    jnp.full_like places fresh constants on the DEFAULT backend, which
+    silently migrated bias/gamma/beta initializations on rigs whose
+    default device differs from the context (round-5 dqn example bug)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import ndarray as nd
+    # a NON-default device, or the test is vacuous (full_like's default
+    # placement would equal `before` anyway)
+    ctx = mx.cpu(1) if mx.num_devices("cpu") > 1 else mx.cpu(0)
+    z = nd.zeros((4,), dtype=np.float32, ctx=ctx)
+    before = z.data.devices()
+    assert before == {ctx.jax_device}
+    z[:] = 0.0
+    assert z.data.devices() == before
+    z[:] = 3.5
+    assert z.data.devices() == before
+    np.testing.assert_allclose(z.asnumpy(), 3.5)

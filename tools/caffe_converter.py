@@ -17,8 +17,19 @@ Supported layer types: Input/Data, Convolution, InnerProduct, Pooling
 SoftmaxWithLoss, Concat, Eltwise (SUM/MAX/PROD), Flatten, BatchNorm (+
 the following Scale layer folded in).
 
+Binary ``.caffemodel`` weights are read directly with a minimal
+protobuf wire-format (varint) reader — no protobuf library: the
+NetParameter message is scanned for ``layer`` (field 100,
+LayerParameter: name=1, blobs=7) and legacy ``layers`` (field 2,
+V1LayerParameter: name=4, blobs=6) entries; each BlobProto carries its
+shape either as BlobShape dims (field 7) or legacy
+num/channels/height/width (fields 1-4) and float data packed or
+unpacked in field 5 (doubles in field 8). This mirrors the reference's
+``tools/caffe_converter/convert_model.py``, which used compiled
+protobuf classes for the same traversal.
+
   python tools/caffe_converter.py deploy.prototxt out_prefix \
-      [--weights weights.npz]
+      [--weights weights.npz | --caffemodel net.caffemodel]
 
 Writes ``out_prefix-symbol.json`` (+ ``out_prefix-0000.params`` when
 weights are given) — loadable by ``mx.mod.Module`` / ``mx.predictor``.
@@ -228,12 +239,161 @@ def convert(net_def, input_shape=None):
     return last, in_shape
 
 
+# ------------------------------------------------- caffemodel wire reader
+
+
+def _read_varint(buf, i):
+    shift, out = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _iter_fields(buf, start=0, end=None):
+    """Yield (field_number, wire_type, payload) over a protobuf message.
+    wire types: 0 varint (payload int), 1 64-bit, 2 length-delimited,
+    5 32-bit (payload bytes)."""
+    i, end = start, len(buf) if end is None else end
+    while i < end:
+        key, i = _read_varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError("unsupported protobuf wire type %d" % wt)
+        yield fno, wt, v
+
+
+def _parse_blob(buf):
+    """BlobProto -> numpy array with its declared shape."""
+    import numpy as np
+    legacy = {}
+    dims = None
+    chunks = []
+    for fno, wt, v in _iter_fields(buf):
+        if fno in (1, 2, 3, 4) and wt == 0:
+            legacy[fno] = v
+        elif fno == 5:      # repeated float data (packed or not)
+            chunks.append(np.frombuffer(v, "<f4"))
+        elif fno == 8:      # repeated double data
+            chunks.append(np.frombuffer(v, "<f8").astype(np.float32))
+        elif fno == 7 and wt == 2:      # BlobShape
+            dims = []
+            for f2, w2, v2 in _iter_fields(v):
+                if f2 != 1:
+                    continue
+                if w2 == 0:             # unpacked dim
+                    dims.append(v2)
+                else:                   # packed varints
+                    j = 0
+                    while j < len(v2):
+                        d, j = _read_varint(v2, j)
+                        dims.append(d)
+    data = np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+    if dims is None and legacy:
+        dims = [legacy.get(k, 1) for k in (1, 2, 3, 4)]
+        # legacy 4D blobs pad leading ones (e.g. InnerProduct weights
+        # are (1, 1, out, in)); strip them like the reference converter
+        while len(dims) > 1 and dims[0] == 1 and \
+                int(np.prod(dims[1:])) == data.size:
+            dims = dims[1:]
+    if dims:
+        data = data.reshape([int(d) for d in dims])
+    return data
+
+
+def parse_caffemodel(raw):
+    """Parse NetParameter bytes -> ordered list of
+    ``(layer_name, [blob arrays])`` (new-style ``layer`` field 100 and
+    legacy ``layers`` field 2 both supported)."""
+    out = []
+    for fno, wt, v in _iter_fields(raw):
+        if wt != 2 or fno not in (2, 100):
+            continue
+        name_field, blob_field = (4, 6) if fno == 2 else (1, 7)
+        name, blobs = None, []
+        for f2, w2, v2 in _iter_fields(v):
+            if f2 == name_field and w2 == 2:
+                name = v2.decode("utf-8", "replace")
+            elif f2 == blob_field and w2 == 2:
+                blobs.append(_parse_blob(v2))
+        if name is not None and blobs:
+            out.append((name, blobs))
+    return out
+
+
+def caffemodel_weights(net_def, raw):
+    """Map a parsed ``.caffemodel`` onto this converter's parameter
+    names (the ``--weights`` npz convention): conv/IP blobs ->
+    ``{name}_weight``/``{name}_bias``; BatchNorm mean/var (divided by
+    the scale-factor blob) -> ``{name}_moving_mean``/``_moving_var``;
+    a following Scale layer's blobs -> the BatchNorm's
+    ``{bn}_gamma``/``{bn}_beta``."""
+    import numpy as np
+    blobs = dict(parse_caffemodel(raw))
+    layers = _as_list(net_def.get("layer")) or _as_list(net_def.get("layers"))
+    by_name = {str(l["name"]): l for l in layers}
+    # Scale layers fold into the BatchNorm they follow (matched by
+    # bottom, like convert() does)
+    bn_of_top = {}
+    for l in layers:
+        if str(l.get("type")) == "BatchNorm":
+            for top in _as_list(l.get("top", [])):
+                bn_of_top[str(top)] = str(l["name"])
+    out = {}
+    for name, layer_blobs in blobs.items():
+        ldef = by_name.get(name, {})
+        ltype = str(ldef.get("type", ""))
+        if ltype == "BatchNorm" or (not ldef and len(layer_blobs) == 3
+                                    and layer_blobs[2].size == 1):
+            mean, var = layer_blobs[0], layer_blobs[1]
+            if len(layer_blobs) > 2 and layer_blobs[2].size == 1:
+                sf = float(layer_blobs[2].ravel()[0])
+                if sf != 0:
+                    mean, var = mean / sf, var / sf
+            out[name + "_moving_mean"] = mean.ravel()
+            out[name + "_moving_var"] = var.ravel()
+        elif ltype == "Scale":
+            bn = bn_of_top.get(str(_as_list(ldef.get("bottom", []))[0]),
+                               name)
+            out[bn + "_gamma"] = layer_blobs[0].ravel()
+            if len(layer_blobs) > 1:
+                out[bn + "_beta"] = layer_blobs[1].ravel()
+        else:
+            w = layer_blobs[0]
+            if ltype == "InnerProduct" and w.ndim > 2:
+                w = w.reshape(w.shape[-2], w.shape[-1])
+            out[name + "_weight"] = w
+            if len(layer_blobs) > 1:
+                out[name + "_bias"] = layer_blobs[1].ravel()
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(description="caffe prototxt -> mx symbol")
     ap.add_argument("prototxt")
     ap.add_argument("out_prefix")
-    ap.add_argument("--weights", default=None,
-                    help=".npz with {layer}_weight/{layer}_bias arrays")
+    wsrc = ap.add_mutually_exclusive_group()
+    wsrc.add_argument("--weights", default=None,
+                      help=".npz with {layer}_weight/{layer}_bias arrays")
+    wsrc.add_argument("--caffemodel", default=None,
+                      help="binary .caffemodel to read weights from "
+                           "(varint-level protobuf reader, no caffe/"
+                           "protobuf needed)")
     args = ap.parse_args()
 
     import numpy as np
@@ -246,18 +406,27 @@ def main():
     print("wrote %s-symbol.json (input shape %s)"
           % (args.out_prefix, in_shape))
 
+    arrays = None
     if args.weights:
-        blob, skipped = {}, []
         with np.load(args.weights) as z:
-            arg_names = set(sym.list_arguments())
-            aux_names = set(sym.list_auxiliary_states())
-            for k in z.files:
-                if k in arg_names:
-                    blob["arg:" + k] = mx.nd.array(z[k])
-                elif k in aux_names:
-                    blob["aux:" + k] = mx.nd.array(z[k])
-                else:
-                    skipped.append(k)
+            arrays = {k: z[k] for k in z.files}
+    elif args.caffemodel:
+        with open(args.caffemodel, "rb") as f:
+            arrays = caffemodel_weights(net_def, f.read())
+        print("parsed %d parameter tensors from %s"
+              % (len(arrays), args.caffemodel))
+
+    if arrays is not None:
+        blob, skipped = {}, []
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        for k, v in arrays.items():
+            if k in arg_names:
+                blob["arg:" + k] = mx.nd.array(v)
+            elif k in aux_names:
+                blob["aux:" + k] = mx.nd.array(v)
+            else:
+                skipped.append(k)
         if skipped:
             print("  skipped %d arrays with no matching symbol arg: %s"
                   % (len(skipped), skipped[:6]))
