@@ -1,0 +1,67 @@
+"""Shared RecordIO data plumbing for the image-classification CLIs.
+
+Capability twin of the reference's
+``example/image-classification/common/data.py``: the same flag surface
+(``--data-train``, ``--data-val``, ``--image-shape``, ``--rgb-mean``,
+``--data-nthreads``, aug knobs) feeding ``ImageRecordIter`` (the C++
+native pipeline when available), plus a synthetic-data path
+(``--benchmark``) mirroring the reference's SyntheticDataIter for
+perf runs and CI smoke tests.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-train", type=str, default=None,
+                      help="training RecordIO (.rec)")
+    data.add_argument("--data-val", type=str, default=None,
+                      help="validation RecordIO (.rec)")
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--num-classes", type=int, default=1000)
+    data.add_argument("--num-examples", type=int, default=1281167,
+                      help="examples per epoch (for lr-step epochs)")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    data.add_argument("--data-nthreads", type=int, default=4)
+    data.add_argument("--rand-crop", type=int, default=1)
+    data.add_argument("--rand-mirror", type=int, default=1)
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="use synthetic data (reference SyntheticDataIter)")
+    return data
+
+
+def get_rec_iters(args, kv=None):
+    """(train, val) ImageRecordIter pair over the flags; --benchmark
+    swaps in deterministic synthetic arrays of the right shape."""
+    image_shape = tuple(int(d) for d in args.image_shape.split(","))
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    if args.benchmark:
+        rng = np.random.RandomState(17)
+        n = max(args.batch_size * 8, 64)
+        x = rng.uniform(0, 1, (n,) + image_shape).astype(np.float32)
+        y = rng.randint(0, args.num_classes, (n,)).astype(np.float32)
+        train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True,
+                                  data_name="data", label_name="softmax_label")
+        val = mx.io.NDArrayIter(x[: n // 4], y[: n // 4], args.batch_size,
+                                data_name="data", label_name="softmax_label")
+        return train, val
+    if not args.data_train:
+        raise ValueError("pass --data-train (or --benchmark 1)")
+    mean = [float(v) for v in args.rgb_mean.split(",")]
+    common = dict(
+        data_shape=image_shape, batch_size=args.batch_size,
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        preprocess_threads=args.data_nthreads,
+        num_parts=nworker, part_index=rank)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, shuffle=True,
+        rand_crop=bool(args.rand_crop), rand_mirror=bool(args.rand_mirror),
+        **common)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(path_imgrec=args.data_val, **common)
+    return train, val

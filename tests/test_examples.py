@@ -225,3 +225,53 @@ def test_tree_lstm_cli():
     baseline decisively."""
     out = _run("tree_lstm.py")
     assert "eval accuracy" in out
+
+
+@pytest.mark.slow
+def test_train_imagenet_benchmark_cli():
+    """The BASELINE north-star CLI (reference train_imagenet.py flag
+    surface) in synthetic --benchmark mode: must train to memorization
+    on the fixed synthetic batch."""
+    out = _run("train_imagenet.py", "--network", "resnet",
+               "--num-layers", "18", "--benchmark", "1",
+               "--num-classes", "10", "--image-shape", "3,64,64",
+               "--num-epochs", "3", "--batch-size", "32",
+               "--num-examples", "256", "--lr", "0.05",
+               "--lr-step-epochs", "")
+    assert "final validation accuracy" in out
+
+
+@pytest.mark.slow
+def test_train_imagenet_recordio_cli(tmp_path):
+    """The same CLI over a real RecordIO file (the reference's data
+    path): pack synthetic images with the recordio codec, train, and
+    assert the accuracy line prints."""
+    import numpy as np
+    import cv2
+    sys.path.insert(0, os.path.join(ROOT))
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(0)
+    n, size = 192, 64
+    y = rng.randint(0, 4, n)
+    x = rng.rand(n, size, size, 3).astype(np.float32) * 0.2
+    for c in range(4):
+        x[y == c, :, :, c % 3] += 0.6
+    for split, idx in (("train", slice(0, 160)), ("val", slice(160, n))):
+        rec = recordio.MXRecordIO(str(tmp_path / (split + ".rec")), "w")
+        xs, ys = x[idx], y[idx]
+        for i in range(xs.shape[0]):
+            ok, enc = cv2.imencode(
+                ".png", (xs[i][:, :, ::-1] * 255).astype(np.uint8))
+            rec.write(recordio.pack(
+                recordio.IRHeader(0, float(ys[i]), i, 0), enc.tobytes()))
+        rec.close()
+    out = _run("train_imagenet.py", "--network", "resnet",
+               "--num-layers", "18",
+               "--data-train", str(tmp_path / "train.rec"),
+               "--data-val", str(tmp_path / "val.rec"),
+               "--image-shape", "3,56,56", "--num-classes", "4",
+               "--num-epochs", "2", "--batch-size", "32",
+               "--num-examples", "160", "--lr", "0.05",
+               "--lr-step-epochs", "", "--rgb-mean", "0,0,0")
+    assert "final validation accuracy" in out

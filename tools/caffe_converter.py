@@ -305,12 +305,11 @@ def _parse_blob(buf):
                         dims.append(d)
     data = np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
     if dims is None and legacy:
+        # keep legacy 4-D dims verbatim: stripping leading ones here
+        # would corrupt e.g. a num_output=1 conv weight (1, C, kh, kw);
+        # consumers that want flat views (InnerProduct, biases, BN
+        # stats) reshape/ravel in caffemodel_weights
         dims = [legacy.get(k, 1) for k in (1, 2, 3, 4)]
-        # legacy 4D blobs pad leading ones (e.g. InnerProduct weights
-        # are (1, 1, out, in)); strip them like the reference converter
-        while len(dims) > 1 and dims[0] == 1 and \
-                int(np.prod(dims[1:])) == data.size:
-            dims = dims[1:]
     if dims:
         data = data.reshape([int(d) for d in dims])
     return data
