@@ -24,7 +24,9 @@ def add_data_args(parser):
     data.add_argument("--image-shape", type=str, default="3,224,224")
     data.add_argument("--num-classes", type=int, default=1000)
     data.add_argument("--num-examples", type=int, default=1281167,
-                      help="examples per epoch (for lr-step epochs)")
+                      help="examples per epoch — fallback for the lr "
+                           "schedule when the iterator cannot report "
+                           "its (per-worker) size")
     data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
     data.add_argument("--data-nthreads", type=int, default=4)
     data.add_argument("--rand-crop", type=int, default=1)

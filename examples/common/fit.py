@@ -81,8 +81,12 @@ def fit(args, network, data_loader, **kwargs):
     train, val = data_loader(args, kv)
 
     devs = _contexts(args)
-    n_examples = len(getattr(train, "_offsets", []) or []) or \
-        getattr(train, "num_data", 0)
+    # per-worker epoch size: num_data reflects distributed sharding
+    # (ImageRecordIter num_parts/part_index); --num-examples is the
+    # fallback when the iterator cannot report its size
+    n_examples = getattr(train, "num_data", 0) or \
+        len(getattr(train, "_offsets", []) or []) or \
+        getattr(args, "num_examples", 0)
     epoch_size = max(n_examples // args.batch_size, 1)   # batches per epoch
     lr, lr_sched = _lr_scheduler(args, epoch_size, kv)
 

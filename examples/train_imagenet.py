@@ -38,17 +38,22 @@ def get_network(args):
     name = args.network
     kw = dict(num_classes=args.num_classes,
               image_shape=args.image_shape)
+    # per-network depth defaults (reference train_imagenet defaults)
+    layers = args.num_layers
+    if layers is None:
+        layers = {"resnet": 50, "vgg": 16}.get(name)
     if name == "resnet":
-        return resnet.get_symbol(num_layers=args.num_layers,
-                                 stem=args.stem, **kw)
+        return resnet.get_symbol(num_layers=layers, stem=args.stem, **kw)
     if name == "vgg":
-        return vgg.get_symbol(num_layers=args.num_layers or 16, **kw)
+        return vgg.get_symbol(num_layers=layers, **kw)
     if name == "alexnet":
         return alexnet.get_symbol(num_classes=args.num_classes)
     if name in ("inception-bn", "inception_bn"):
-        return inception.get_symbol_bn(num_classes=args.num_classes)
+        return inception.get_symbol(num_classes=args.num_classes,
+                                    version="bn")
     if name in ("inception-v3", "inception_v3"):
-        return inception.get_symbol_v3(num_classes=args.num_classes)
+        return inception.get_symbol(num_classes=args.num_classes,
+                                    version="v3")
     if name == "lenet":
         return lenet.get_symbol(num_classes=args.num_classes)
     if name == "mlp":
@@ -62,7 +67,8 @@ def main():
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     fit_mod.add_fit_args(parser)
     data_mod.add_data_args(parser)
-    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--num-layers", type=int, default=None,
+                        help="network depth (default: resnet 50, vgg 16)")
     parser.add_argument("--stem", type=str, default="7x7",
                         choices=["7x7", "s2d"],
                         help="resnet stem lowering (s2d = space-to-depth"

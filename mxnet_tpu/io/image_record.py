@@ -63,8 +63,17 @@ class ImageRecordIter(DataIter):
                  prefetch_buffer: Optional[int] = None,
                  round_batch: bool = True, data_name: str = "data",
                  label_name: str = "softmax_label", dtype="float32",
-                 silent: bool = False, aug_list=None, **kwargs):
+                 silent: bool = False, aug_list=None,
+                 num_parts: int = 1, part_index: int = 0, **kwargs):
         super().__init__(batch_size)
+        # distributed data sharding (reference: ImageRecParserParam
+        # kNumParts/kPartIndex): worker part_index of num_parts reads
+        # every num_parts-th record; num_data reports the shard size
+        self._num_parts = max(int(num_parts), 1)
+        self._part_index = int(part_index)
+        if not 0 <= self._part_index < self._num_parts:
+            raise ValueError("part_index %d not in [0, num_parts=%d)"
+                             % (self._part_index, self._num_parts))
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = label_width
         self._dtype = np.dtype(dtype)
@@ -105,7 +114,8 @@ class ImageRecordIter(DataIter):
             if self._native.handle is None:
                 self._native = None
         if self._native is not None:
-            self._order = np.arange(self._native.count)
+            self._order = np.arange(self._native.count)[
+                self._part_index::self._num_parts]
             self._native.start_epoch(self._epoch_order())
             return
 
@@ -120,7 +130,8 @@ class ImageRecordIter(DataIter):
                 break
             self._offsets.append(pos)
         rec.close()
-        self._order = np.arange(len(self._offsets))
+        self._order = np.arange(len(self._offsets))[
+            self._part_index::self._num_parts]
         self._epoch_queue: "queue.Queue" = queue.Queue()
         self._batch_queue: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
         self._lock = threading.Lock()

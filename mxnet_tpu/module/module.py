@@ -487,8 +487,21 @@ class Module(BaseModule):
 
             if remat:
                 # trade forward recompute for activation HBM
-                # (MXNET_EXEC_ENABLE_REMAT; jax.checkpoint)
-                loss_fn = jax.checkpoint(loss_fn)
+                # (MXNET_EXEC_ENABLE_REMAT). The fused step is one flat
+                # trace with no layer blocks to checkpoint between, so
+                # the save-policy form is used (keep non-batch matmul
+                # outputs, recompute elementwise) — structure-free
+                # jax.checkpoint(loss_fn) measured slightly WORSE
+                # (840 -> 844 MB, tools/perf/doc_evidence.py). Honest
+                # caveat from the same measurement: on dense-attention
+                # transformers neither form cuts peak (the T^2 score
+                # tensors must exist during the backward recompute
+                # anyway); the framework's real memory lever is
+                # custom-vjp residual control (flash attention, LN) —
+                # see docs/architecture/note_memory.md
+                loss_fn = jax.checkpoint(
+                    loss_fn,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
             (outs, new_aux), vjp = jax.vjp(loss_fn, params)
             cts = [jnp.ones_like(o) for o in outs]

@@ -209,3 +209,39 @@ def test_recordio_multipart_write_roundtrip(tmp_path):
     assert rec.read() == payload
     assert rec.read() is None
     rec.close()
+
+
+def test_image_record_iter_sharding(tmp_path):
+    """num_parts/part_index must partition the records disjointly
+    (distributed data parallelism; reference ImageRecParserParam)."""
+    import cv2
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(0)
+    path = str(tmp_path / "s.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    n = 20
+    for i in range(n):
+        img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+        ok, enc = cv2.imencode(".png", img)
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                enc.tobytes()))
+    rec.close()
+
+    seen = []
+    for part in (0, 1):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=path, data_shape=(3, 8, 8), batch_size=5,
+            num_parts=2, part_index=part, round_batch=False)
+        assert it.num_data == n // 2
+        labels = []
+        for b in it:
+            lab = np.asarray(b.label[0].asnumpy()).ravel()
+            if b.pad:
+                lab = lab[: len(lab) - b.pad]
+            labels.extend(lab.tolist())
+        seen.append(set(int(v) for v in labels))
+    assert seen[0].isdisjoint(seen[1])
+    assert seen[0] | seen[1] == set(range(n))
+    with pytest.raises(ValueError):
+        mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                              batch_size=5, num_parts=2, part_index=2)

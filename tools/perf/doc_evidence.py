@@ -1,0 +1,121 @@
+"""Measurements cited by docs/architecture/* (round 5).
+
+Three numbers the design notes assert and should prove:
+1. buffer donation: compiled argument/output aliasing and temp memory
+   of the fused train step with vs without donated params
+2. remat: compiled temp memory of the transformer step with vs without
+   MXNET_EXEC_ENABLE_REMAT
+3. fused step vs eager dispatch: same MLP trained via Module._fit_step
+   (one jitted program) vs an eager per-op loop
+Runs on the CPU backend (memory analysis is layout-exact there too).
+"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import mxnet_tpu as mx
+
+
+def main():
+    # ---- 1+2: memory analysis of the real fused step under flags
+    from mxnet_tpu.models import transformer
+    for tag, env in (("baseline", {}),
+                     ("remat", {"MXNET_EXEC_ENABLE_REMAT": "1"})):
+        for k, v in env.items():
+            os.environ[k] = v
+        mx.config.reset("MXNET_EXEC_ENABLE_REMAT")
+        sym = transformer.get_symbol(vocab_size=512, num_layers=6,
+                                     d_model=256, n_heads=8, seq_len=256)
+        mod = mx.mod.Module(sym, context=mx.cpu(0))
+        mod.bind(data_shapes=[("data", (16, 256))],
+                 label_shapes=[("softmax_label", (16, 256))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        rng = np.random.RandomState(0)
+        db = mx.io.DataBatch(
+            data=[mx.nd.array(rng.randint(0, 512, (16, 256))
+                              .astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, 512, (16, 256))
+                               .astype(np.float32))])
+        mod._fit_step(db)
+        # reach the jitted step and re-lower it with the live arguments
+        # to read XLA's memory analysis
+        import jax as _jax
+        ex = mod._exec
+        params = {n: ex.arg_dict[n].data for n in mod._param_names}
+        states = mod._fused_states
+        aux = {n: a.data for n, a in ex.aux_dict.items()}
+        inputs = {n: ex.arg_dict[n].data
+                  for n in ("data", "softmax_label")}
+        comp = mod._fused_jit.lower(
+            params, states, aux, inputs, {}, _jax.random.PRNGKey(0),
+            jnp.asarray(0.1, jnp.float32),
+            jnp.asarray(1, jnp.int32)).compile()
+        ma = comp.memory_analysis()
+        print("%s: temp %.2f MB  args %.2f MB  out %.2f MB  "
+              "alias %.2f MB" % (
+                  tag, ma.temp_size_in_bytes / 1e6,
+                  ma.argument_size_in_bytes / 1e6,
+                  ma.output_size_in_bytes / 1e6,
+                  getattr(ma, "alias_size_in_bytes", 0) / 1e6))
+        for k in env:
+            del os.environ[k]
+        mx.config.reset("MXNET_EXEC_ENABLE_REMAT")
+
+    # ---- 3: fused step vs eager per-op training loop, same MLP
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 64).astype(np.float32)
+    Y = rng.randint(0, 10, (256,)).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    sym = mx.sym.SoftmaxOutput(h, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (256, 64))],
+             label_shapes=[("softmax_label", (256,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    db = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+    mod._fit_step(db)
+    t0 = time.perf_counter()
+    for _ in range(100):
+        mod._fit_step(db)
+    mod.get_params()
+    fused = 100 / (time.perf_counter() - t0)
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    net = nn.Sequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    xb, yb = mx.nd.array(X), mx.nd.array(Y)
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = mx.nd.mean(sce(net(xb), yb))
+        loss.backward()
+        tr.step(1)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        with mx.autograd.record():
+            loss = mx.nd.mean(sce(net(xb), yb))
+        loss.backward()
+        tr.step(1)
+    float(np.asarray(loss.asnumpy()).ravel()[0])
+    eager = 30 / (time.perf_counter() - t0)
+    print("fused step: %.0f steps/s   eager loop: %.1f steps/s   (%.0fx)"
+          % (fused, eager, fused / eager))
+
+
+if __name__ == "__main__":
+    main()
