@@ -1,0 +1,91 @@
+"""FGSM adversarial examples: attack a trained classifier via input
+gradients.
+
+Capability twin of the reference's ``example/adversary`` (Goodfellow et
+al. FGSM): train a small MLP, then compute the loss gradient **with
+respect to the input image** and step in its sign direction — accuracy
+on the perturbed batch must collapse while the perturbation stays
+eps-bounded. Exercises gradient-wrt-input through a *trained* model
+(neural_style.py optimizes an input against fixed features; this
+attacks a learned decision boundary).
+
+Run:  python examples/adversary_fgsm.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def synth_digits(n, seed=0):
+    """10-class 16x16 'digit' patterns: class = which cell of a 4-row
+    template grid is lit, plus noise."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 1, 16, 16).astype(np.float32) * 0.25
+    for c in range(10):
+        r, co = divmod(c, 4)
+        x[y == c, 0, 4 * r:4 * r + 4, 4 * co:4 * co + 4] += 0.65
+    return np.clip(x, 0, 1), y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description="FGSM adversarial attack")
+    p.add_argument("--num-epochs", type=int, default=6)
+    p.add_argument("--eps", type=float, default=0.3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    np.random.seed(args.seed)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    X, Y = synth_digits(1500, seed=1)
+    Xv, Yv = synth_digits(300, seed=2)
+
+    net = nn.Sequential()
+    net.add(nn.Flatten(), nn.Dense(128, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    bs = 100
+    for epoch in range(args.num_epochs):
+        for i in range(0, len(Y), bs):
+            xb = mx.nd.array(X[i:i + bs])
+            yb = mx.nd.array(Y[i:i + bs])
+            with mx.autograd.record():
+                loss = mx.nd.mean(sce(net(xb), yb))
+            loss.backward()
+            trainer.step(1)
+
+    xv = mx.nd.array(Xv)
+    yv = mx.nd.array(Yv)
+    clean_acc = float((net(xv).asnumpy().argmax(1) == Yv).mean())
+
+    # FGSM: x_adv = x + eps * sign(dL/dx)
+    xv.attach_grad()
+    with mx.autograd.record():
+        loss = mx.nd.mean(sce(net(xv), yv))
+    loss.backward()
+    g = xv.grad.asnumpy()
+    x_adv = np.clip(Xv + args.eps * np.sign(g), 0, 1)
+    adv_acc = float((net(mx.nd.array(x_adv)).asnumpy().argmax(1)
+                     == Yv).mean())
+    linf = float(np.abs(x_adv - Xv).max())
+    print("clean accuracy: %.3f   FGSM(eps=%.2f) accuracy: %.3f   "
+          "Linf=%.3f" % (clean_acc, args.eps, adv_acc, linf))
+    assert clean_acc > 0.95, "model failed to train"
+    assert adv_acc < 0.5 * clean_acc, "attack did not degrade the model"
+    assert linf <= args.eps + 1e-6, "perturbation exceeded the budget"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

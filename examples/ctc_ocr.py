@@ -1,0 +1,141 @@
+"""CTC sequence recognition: unsegmented label learning (captcha-style).
+
+Capability twin of the reference's ``example/ctc`` /
+``example/warpctc``: a recurrent model reads a rendered digit strip and
+is trained with CTCLoss against the UNSEGMENTED label sequence — no
+per-frame alignment is given; CTC's forward-backward marginalizes over
+alignments (the reference bundles Baidu warp-ctc in CUDA for this; here
+``CTCLoss`` lowers to a jax dynamic program, ops/contrib).
+
+Decoding is best-path (greedy) with blank/duplicate collapse; the gate
+is full-sequence accuracy on held-out strips.
+
+Run:  python examples/ctc_ocr.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N_DIGIT, W_DIGIT, H = 2, 8, 12     # 3 digits, 8 cols each + jitter
+N_CLASS = 5                         # digits 0..4; CTC blank = N_CLASS
+T_FRAMES = N_DIGIT * W_DIGIT + 6
+
+
+def render(y, rng):
+    """Render a digit sequence into an (H, T) strip with horizontal
+    position jitter (so frames don't align to labels). Each digit is a
+    solid 2-row bar whose vertical position encodes its class."""
+    strip = rng.rand(H, T_FRAMES).astype(np.float32) * 0.2
+    pos = 1
+    for d in y:
+        pos += rng.randint(0, 3)
+        r0 = 1 + 2 * int(d)
+        strip[r0:r0 + 2, pos:pos + 4] += 0.8
+        pos += W_DIGIT - 2
+    return np.clip(strip, 0, 1)
+
+
+def synth(n, seed):
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, N_CLASS, (n, N_DIGIT))
+    xs = np.stack([render(y, rng) for y in ys])
+    return xs.astype(np.float32), ys.astype(np.float32)
+
+
+def greedy_decode(probs):
+    """(T, B, C+1) frame posteriors -> collapsed sequences (class 0 is
+    the CTC blank, warp-ctc convention; classes 1..C map to digits
+    0..C-1)."""
+    ids = probs.argmax(axis=2)                    # (T, B)
+    out = []
+    for b in range(ids.shape[1]):
+        seq, prev = [], -1
+        for t in range(ids.shape[0]):
+            k = int(ids[t, b])
+            if k != prev and k != 0:
+                seq.append(k - 1)
+            prev = k
+        out.append(seq)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description="CTC digit-strip OCR")
+    p.add_argument("--num-epochs", type=int, default=150)
+    p.add_argument("--num-examples", type=int, default=100)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    np.random.seed(args.seed)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    X, Y = synth(args.num_examples, seed=1)
+    Xv, Yv = synth(200, seed=2)
+
+    class Net(gluon.Block):
+        """Conv feature extractor over frames -> per-frame class scores.
+        (The reference's ctc examples use LSTM encoders; any per-frame
+        encoder works — CTC itself is the capability under test, and a
+        conv front-end keeps the eager forward cheap.)"""
+
+        def __init__(self, **kw):
+            super(Net, self).__init__(**kw)
+            with self.name_scope():
+                self.c1 = nn.Conv2D(args.hidden, kernel_size=(H, 5),
+                                    padding=(0, 2))
+                self.c2 = nn.Conv2D(args.hidden, kernel_size=(1, 5),
+                                    padding=(0, 2), activation="relu")
+                self.c3 = nn.Conv2D(N_CLASS + 1, kernel_size=(1, 1))
+
+        def forward(self, x):             # x: (B, H, T) strip
+            h = mx.nd.expand_dims(x, axis=1)           # (B, 1, H, T)
+            h = mx.nd.Activation(self.c1(h), act_type="relu")
+            h = self.c3(self.c2(h))                    # (B, C+1, 1, T)
+            h = mx.nd.squeeze(h, axis=2)               # (B, C+1, T)
+            return mx.nd.transpose(h, axes=(0, 2, 1))  # (B, T, C+1)
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    bs = min(args.num_examples, 100)
+    for epoch in range(args.num_epochs):
+        tot = 0.0
+        for i in range(0, len(X), bs):
+            xb = mx.nd.array(X[i:i + bs])
+            yb = mx.nd.array(Y[i:i + bs])
+            with mx.autograd.record():
+                logits = net(xb)                       # (B, T, C+1)
+                # CTCLoss wants (T, B, C+1) activations
+                act = mx.nd.transpose(logits, axes=(1, 0, 2))
+                # warp-ctc label convention: classes 1..C,
+                # 0 = blank/padding
+                loss = mx.nd.mean(mx.nd.CTCLoss(act, yb + 1))
+            loss.backward()
+            trainer.step(1)
+            tot += float(np.asarray(loss.asnumpy()).ravel()[0])
+        print("Epoch[%d] ctc-loss=%.4f" % (epoch, tot / (len(X) / bs)),
+              flush=True)
+
+    logits = net(mx.nd.array(Xv)).asnumpy()
+    probs = np.transpose(logits, (1, 0, 2))
+    dec = greedy_decode(probs)
+    ok = sum(1 for d, y in zip(dec, Yv)
+             if d == [int(v) for v in y])
+    acc = ok / len(Yv)
+    print("sequence accuracy: %.3f" % acc)
+    assert acc > 0.8, "CTC model failed to learn unsegmented sequences"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,6 +170,152 @@ def mark_variables(variables, gradients, grad_reqs="write") -> None:
         s.marked[var._uid] = weakref.ref(var)
 
 
+_BWD_CACHE: dict = {}
+_BWD_CACHE_MAX = 128
+
+
+_FAILED = object()     # negative-cache sentinel
+
+
+class _Uncacheable(Exception):
+    """Tape cannot use the compiled path; backward falls back to the
+    eager replay."""
+
+
+def _is_jax_value(v):
+    return isinstance(v, jax.Array) or hasattr(v, "aval")
+
+
+def _compiled_backward(used, seed_keys, head_keys, primals, cts_in):
+    """Jit-compiled tape backward with a structure-keyed cache.
+
+    The tape slice is normalized into a position-based plan (keys
+    relabeled by first appearance, captured tensors and PRNG-key attrs
+    lifted to dynamic arguments), so two slices with identical op
+    structure and operand shapes/dtypes share one compiled program
+    regardless of the concrete arrays involved — the repeated-structure
+    training loop compiles once and afterwards costs one dispatch.
+    """
+    import numpy as _np
+
+    def _static_key(v):
+        """Cache-key form of a static constant — must be COLLISION-FREE:
+        array-likes go through the dynamic path instead (repr of a large
+        numpy array truncates, which would alias two different tapes
+        onto one compiled closure with a stale baked-in constant), and
+        anything else unhashable beyond plain list/tuple nesting makes
+        the tape uncacheable (eager fallback)."""
+        if isinstance(v, (list, tuple)):
+            return tuple(_static_key(x) for x in v)
+        try:
+            hash(v)
+            return v
+        except TypeError:
+            raise _Uncacheable(str(type(v)))
+
+    key_index = {k: i for i, k in enumerate(seed_keys)}
+    dyn_vals: List = []
+    plan = []
+    sig_entries = []
+    as_dyn = lambda v: (_is_jax_value(v) or
+                        isinstance(v, _np.ndarray) or
+                        hasattr(v, "__array_interface__"))
+    for e in used:
+        slots = []
+        sig_slots = []
+        for k, c in zip(e.in_keys, e.in_consts):
+            if k is not None and k in key_index:
+                slots.append(("env", key_index[k]))
+                sig_slots.append(("env", key_index[k]))
+            elif as_dyn(c):
+                slots.append(("dyn", len(dyn_vals)))
+                sig_slots.append(("dyn", len(dyn_vals)))
+                dyn_vals.append(jnp.asarray(c))
+            else:
+                slots.append(("static", c))
+                sig_slots.append(("static", _static_key(c)))
+        attr_static, attr_dyn = [], []
+        for name in sorted(e.attrs):
+            v = e.attrs[name]
+            if as_dyn(v):
+                attr_dyn.append((name, len(dyn_vals)))
+                dyn_vals.append(jnp.asarray(v))
+            else:
+                attr_static.append((name, v))
+        outs_idx = []
+        for k in e.out_keys:
+            if k not in key_index:
+                key_index[k] = len(key_index)
+            outs_idx.append(key_index[k])
+        plan.append((e.op.fn, tuple(slots), tuple(attr_static),
+                     tuple(attr_dyn), tuple(outs_idx)))
+        sig_entries.append((
+            e.op.name, tuple(sig_slots),
+            tuple((n, _static_key(v)) for n, v in attr_static),
+            tuple(attr_dyn), tuple(outs_idx)))
+    head_slots = tuple(key_index[h] for h in head_keys)
+    env_size = len(key_index)
+    n_seeds = len(seed_keys)
+
+    aval = lambda v: (tuple(v.shape), str(v.dtype))
+    sig = (tuple(sig_entries), head_slots, n_seeds,
+           tuple(aval(p) for p in primals),
+           tuple(aval(d) for d in dyn_vals),
+           tuple(aval(c) if c is not None else None
+                 for c in (cts_in or [])) if cts_in is not None else None)
+
+    runner = _BWD_CACHE.get(sig)
+    if runner is _FAILED:
+        # negative cache: this structure failed to trace once — don't
+        # pay a full re-trace on every subsequent step just to fall
+        # back again
+        raise _Uncacheable("structure previously failed to compile")
+    if runner is None:
+        def fwd(seed_vals, dyn):
+            env = [None] * env_size
+            env[:n_seeds] = list(seed_vals)
+            for op_fn, slots, attr_static, attr_dyn, outs_idx in plan:
+                args = [env[i] if tag == "env"
+                        else (dyn[i] if tag == "dyn" else i)
+                        for tag, i in slots]
+                attrs = dict(attr_static)
+                for name, j in attr_dyn:
+                    attrs[name] = dyn[j]
+                outs = op_fn(*args, **attrs)
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                for i, o in zip(outs_idx, outs):
+                    env[i] = o
+            return [env[i] for i in head_slots]
+
+        @jax.jit
+        def runner(seed_vals, dyn, cts):
+            heads, vjp_fn = jax.vjp(lambda sv: fwd(sv, dyn),
+                                    list(seed_vals))
+            full_cts = [jnp.ones_like(h) if (cts is None or
+                                             cts[i] is None)
+                        else cts[i]
+                        for i, h in enumerate(heads)]
+            (grads,) = vjp_fn(full_cts)
+            return grads
+
+        # cache only after a successful first run (a broken runner in
+        # the cache would re-trace + fail on every later step)
+        try:
+            out = runner(list(primals), dyn_vals, cts_in)
+        except Exception:
+            if len(_BWD_CACHE) >= _BWD_CACHE_MAX:
+                _BWD_CACHE.pop(next(iter(_BWD_CACHE)))
+            _BWD_CACHE[sig] = _FAILED
+            raise
+        if len(_BWD_CACHE) >= _BWD_CACHE_MAX:
+            _BWD_CACHE.pop(next(iter(_BWD_CACHE)))
+        _BWD_CACHE[sig] = runner
+        return out
+
+    return runner(list(primals), dyn_vals, cts_in)
+
+
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True) -> None:
     """Compute gradients of heads w.r.t. all marked variables (reference:
@@ -256,16 +402,32 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
                 "in-place after recording?)") from None
 
     primals = [p for _, _, p in seeds]
-    head_vals, vjp_fn = jax.vjp(lambda *vs: replay(list(vs)), *primals)
-    if head_grads is None:
-        cts = [jnp.ones_like(h) for h in head_vals]
-    else:
-        cts = [
+    grads = None
+    if head_grads is not None:
+        cts_in = [
             (g.data if isinstance(g, NDArray) else jnp.asarray(g))
-            if g is not None else jnp.ones_like(h)
-            for g, h in zip(head_grads, head_vals)
+            if g is not None else None
+            for g in head_grads
         ]
-    grads = vjp_fn(cts)
+    else:
+        cts_in = None
+    try:
+        # fast path: the tape slice compiles to ONE cached XLA program
+        # keyed on its structure — repeated same-shape training loops
+        # (the gluon hot path) stop paying per-op dispatch in both
+        # directions and recompile nothing after the first step
+        grads = _compiled_backward(used, seed_keys, head_keys, primals,
+                                   cts_in)
+    except Exception:                                  # noqa: BLE001
+        # correctness over speed: any structure the compiled path cannot
+        # express falls back to the original eager replay
+        head_vals, vjp_fn = jax.vjp(lambda *vs: replay(list(vs)), *primals)
+        if cts_in is None:
+            cts = [jnp.ones_like(h) for h in head_vals]
+        else:
+            cts = [c if c is not None else jnp.ones_like(h)
+                   for c, h in zip(cts_in, head_vals)]
+        grads = vjp_fn(cts)
 
     # Sum per-variable (a var may seed several versions), then commit.
     acc = {}

@@ -12,6 +12,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The remote-TPU plugin rides PYTHONPATH (a sitecustomize that dials its
+# relay at interpreter start) — when the tunnel wedges, every subprocess
+# the suite spawns hangs before main() runs. The whole suite is
+# CPU-targeted and every spawned script sys.path-inserts the repo root
+# itself, so drop the plugin path from the inherited environment.
+os.environ["PYTHONPATH"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -12,6 +12,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(script, *args, timeout=560):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the remote-TPU plugin rides PYTHONPATH (sitecustomize) and dials
+    # its relay at interpreter start — a wedged tunnel then hangs every
+    # subprocess before main() runs. The example tier is CPU-targeted,
+    # so drop the plugin path entirely (scripts sys.path.insert the
+    # repo root themselves).
+    env["PYTHONPATH"] = ""
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     # share the suite's persistent compile cache (the config knob) so the
     # subprocess doesn't recompile everything under load
@@ -275,3 +281,19 @@ def test_train_imagenet_recordio_cli(tmp_path):
                "--num-examples", "160", "--lr", "0.05",
                "--lr-step-epochs", "", "--rgb-mean", "0,0,0")
     assert "final validation accuracy" in out
+
+
+@pytest.mark.slow
+def test_adversary_fgsm_cli():
+    """FGSM attack (reference example/adversary): gradient wrt input of
+    a TRAINED model collapses its accuracy within an Linf budget."""
+    out = _run("adversary_fgsm.py")
+    assert "FGSM" in out
+
+
+@pytest.mark.slow
+def test_ctc_ocr_cli():
+    """CTC over unsegmented digit strips (reference example/ctc +
+    warpctc): alignment-free sequence learning + greedy decode."""
+    out = _run("ctc_ocr.py")
+    assert "sequence accuracy" in out
