@@ -297,3 +297,19 @@ def test_ctc_ocr_cli():
     warpctc): alignment-free sequence learning + greedy decode."""
     out = _run("ctc_ocr.py")
     assert "sequence accuracy" in out
+
+
+@pytest.mark.slow
+def test_svm_mnist_cli():
+    """SVMOutput margin heads (reference example/svm_mnist): both SVM
+    variants and softmax clear the bar on the same features."""
+    out = _run("svm_mnist.py")
+    assert "l2-svm" in out
+
+
+@pytest.mark.slow
+def test_multi_task_cli():
+    """Two loss heads on one backbone with two bound labels (reference
+    example/multi-task); must beat split-budget single-task models."""
+    out = _run("multi_task.py")
+    assert "multi-task" in out
