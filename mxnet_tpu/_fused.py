@@ -1,0 +1,442 @@
+"""Structure-keyed compile machinery shared by the tape backward and the
+fused whole-model optimizer step.
+
+The reference MXNet pushes every parameter update through the dependency
+engine as an independent per-key op (KVStore push/pull + per-index
+``Updater`` — python/mxnet/optimizer.py:940), which on TPU is hundreds of
+tiny XLA dispatches per training step. The same dispatch-bound regime was
+already eliminated for the backward pass (autograd._compiled_backward);
+this module factors the caching scheme out of autograd so both hot paths
+use ONE signature discipline, and adds :class:`FusedUpdater` — the
+multi-tensor-apply layer (NVIDIA Apex / PyTorch ``_foreach_`` fused
+optimizers) that batches all live ``(weight, grad, state)`` triples into a
+single jitted, donated update program per (optimizer, structure).
+
+Caching contract (used by both caches):
+
+* A **signature** must be collision-free: two different computations may
+  never map to one sig. Anything that cannot be keyed safely raises
+  :class:`Uncacheable` and the caller falls back to the eager path.
+* Compiled runners are stored only after a successful first run.
+* Failures are negative-cached with **bounded retry**: structural
+  untraceability (tracer-leak/concretization errors) pins the sig to
+  eager permanently, anything else (transient allocator/runtime hiccups)
+  is retried a few times before giving up — a single flaky failure must
+  not permanently demote a structure to per-op dispatch.
+* Every hit/compile/failure increments a :mod:`profiler` counter
+  (``<name>_compile`` / ``<name>_cache_hit`` / ...) so tests and tooling
+  can assert "exactly one executable per step after warmup".
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from . import profiler as _profiler
+
+__all__ = [
+    "CompileCache", "Uncacheable", "op_identity", "fn_token", "static_key",
+    "aval_key", "structural_failure", "FusedUpdater",
+]
+
+
+class Uncacheable(Exception):
+    """The structure cannot use a compiled fast path; the caller must fall
+    back to eager execution."""
+
+
+# ------------------------------------------------------------ CompileCache
+
+_MAX_TRANSIENT_RETRIES = 3
+
+
+class CompileCache:
+    """sig -> compiled runner, with LRU-ish eviction and bounded-retry
+    negative caching.
+
+    ``name`` prefixes the profiler counters: ``<name>_compile`` (a runner
+    was built and stored), ``<name>_cache_hit`` (a stored runner was
+    reused), ``<name>_compile_failed`` (a build attempt raised),
+    ``<name>_neg_hit`` (a sig was skipped because it previously failed).
+    """
+
+    def __init__(self, name: str, max_entries: int = 128):
+        self.name = name
+        self.max_entries = max_entries
+        self._entries: Dict[Any, Any] = {}
+        # sig -> [failure_count, permanent]
+        self._failures: Dict[Any, List] = {}
+        self._lock = threading.Lock()
+
+    def get(self, sig):
+        with self._lock:
+            runner = self._entries.get(sig)
+        if runner is not None:
+            _profiler.incr_counter(self.name + "_cache_hit")
+        return runner
+
+    def put(self, sig, runner) -> None:
+        with self._lock:
+            if sig not in self._entries and \
+                    len(self._entries) >= self.max_entries:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[sig] = runner
+            # a success wipes the failure history for this structure
+            self._failures.pop(sig, None)
+        _profiler.incr_counter(self.name + "_compile")
+
+    def should_skip(self, sig) -> bool:
+        """True when this structure is negative-cached: permanently
+        untraceable, or transiently failed too many times."""
+        with self._lock:
+            rec = self._failures.get(sig)
+            skip = rec is not None and \
+                (rec[1] or rec[0] >= _MAX_TRANSIENT_RETRIES)
+        if skip:
+            _profiler.incr_counter(self.name + "_neg_hit")
+        return skip
+
+    def note_success(self, sig) -> None:
+        """A cached runner executed successfully: clear the transient
+        failure count so isolated hiccups spread over a long run can
+        never accumulate into a permanent demotion."""
+        with self._lock:
+            self._failures.pop(sig, None)
+
+    def mark_failed(self, sig, permanent: bool = False) -> None:
+        with self._lock:
+            if sig not in self._failures and \
+                    len(self._failures) >= self.max_entries:
+                self._failures.pop(next(iter(self._failures)))
+            rec = self._failures.setdefault(sig, [0, False])
+            rec[0] += 1
+            rec[1] = rec[1] or permanent
+        _profiler.incr_counter(self.name + "_compile_failed")
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._failures.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+def _is_uncacheable(exc: BaseException) -> bool:
+    """True when exc is (or was caused by) Uncacheable — jax re-raises
+    user exceptions from inside traces, sometimes chained."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        if isinstance(exc, Uncacheable):
+            return True
+        seen.add(id(exc))
+        exc = exc.__cause__ or exc.__context__
+    return False
+
+
+def structural_failure(exc: BaseException) -> bool:
+    """Classify a compile/trace failure: structural failures (the function
+    genuinely cannot be traced — python control flow on tracers, host
+    round-trips) are permanent; everything else is presumed transient and
+    retried with a bound."""
+    if isinstance(exc, Uncacheable):
+        return True
+    err = jax.errors
+    structural = (err.ConcretizationTypeError, err.TracerArrayConversionError,
+                  err.TracerBoolConversionError,
+                  err.TracerIntegerConversionError,
+                  err.UnexpectedTracerError)
+    if isinstance(exc, structural):
+        return True
+    return isinstance(exc, (TypeError, NotImplementedError)) and \
+        "Tracer" in str(exc)
+
+
+# ------------------------------------------------------- signature scheme
+
+_fn_tokens: Dict[int, Tuple[int, Any]] = {}
+_fn_token_counter = itertools.count()
+
+
+def fn_token(fn) -> int:
+    """Stable, non-reusable identity token for a function object.
+
+    ``id(fn)`` alone can alias after garbage collection; here the id is
+    only trusted while a weakref confirms the same object is alive, and
+    dead entries self-remove, so a recycled id gets a fresh token."""
+    key = id(fn)
+    ent = _fn_tokens.get(key)
+    if ent is not None and ent[1]() is fn:
+        return ent[0]
+    token = next(_fn_token_counter)
+    try:
+        ref = weakref.ref(fn, lambda _r, _k=key: _fn_tokens.pop(_k, None))
+    except TypeError:
+        raise Uncacheable("cannot key compiled program by %r" % (fn,))
+    _fn_tokens[key] = (token, ref)
+    return token
+
+
+def op_identity(op):
+    """Cache identity of an OpDef for compiled-program signatures.
+
+    * Registry-global ops: the canonical name IS the identity (one fn per
+      name for the process lifetime).
+    * ``_Function_*`` ops: every ``autograd.Function.__call__`` builds a
+      fresh custom_vjp closure, so a name-keyed sig aliases two instances
+      onto the first one's compiled program (silently wrong gradients —
+      the Scale(2.0)/Scale(3.0) collision) and a token-keyed sig would
+      recompile on every call. They are uncacheable by construction.
+    * Other closure-backed ops (hybridized ``_cached_op_*`` jits, which
+      ARE reused across steps): name + a non-reusable per-fn token, so two
+      same-shaped blocks that happen to share a name cannot replay each
+      other's programs.
+    """
+    from .ops.registry import OP_REGISTRY
+    if OP_REGISTRY.get(op.name) is op:
+        return op.name
+    if op.name.startswith("_Function_"):
+        raise Uncacheable("per-call Function op %s" % op.name)
+    return (op.name, fn_token(op.fn))
+
+
+def static_key(v):
+    """Cache-key form of a static constant — must be COLLISION-FREE:
+    array-likes go through the dynamic path instead (repr of a large numpy
+    array truncates, which would alias two different computations onto one
+    compiled closure with a stale baked-in constant), and anything else
+    unhashable beyond plain list/tuple nesting raises Uncacheable."""
+    if isinstance(v, (list, tuple)):
+        return tuple(static_key(x) for x in v)
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        raise Uncacheable(str(type(v)))
+
+
+def aval_key(v) -> Tuple[tuple, Any]:
+    # np.dtype objects hash/compare fast; str(dtype) costs ~15us each and
+    # the trainer-step signature touches 3 arrays per param per step
+    return (tuple(v.shape), v.dtype)
+
+
+# --------------------------------------------------------- fused updater
+
+
+def _state_raw(s):
+    """NDArray state tree -> raw jax-array tree (None passes through)."""
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_state_raw(x) for x in s)
+    return s._data
+
+
+def _state_sig(s):
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_state_sig(x) for x in s)
+    return aval_key(s._data)
+
+
+def _commit_state(old, new):
+    """Write updated raw values back into the EXISTING NDArray state tree
+    in place, so ``Updater.states`` keeps one object identity whether steps
+    run fused or eager (save/load_states and mid-training fallback both
+    keep working)."""
+    if old is None:
+        return None
+    if isinstance(old, tuple):
+        return tuple(_commit_state(o, n) for o, n in zip(old, new))
+    old._data = new
+    old._version += 1
+    return old
+
+
+class FusedUpdater:
+    """Whole-model fused optimizer step over a standard ``opt.Updater``.
+
+    ``__call__`` gathers every live ``(index, weight, grad)`` triple and
+    executes ONE jitted update program built from
+    ``Optimizer.update_fused``, cached by a structure key (param
+    shapes/dtypes/mults + optimizer class + static hyperparams). The
+    cache is per-updater so compiled runners (which close over the
+    optimizer) die with the trainer instead of pinning it in a global
+    table; counters still aggregate under the shared ``trainer_step``
+    prefix. Per-step hyperparameters — lr, wd, rescale_grad, the clip
+    threshold, and the per-param update counts — enter as dynamic
+    scalars, so LR schedules and batch-size changes never recompile.
+    Weight and state buffers are donated on accelerator backends, making
+    the update in-place in HBM (donation is skipped on CPU where XLA
+    ignores it).
+
+    Fallback matrix (returns False -> caller runs the per-param path):
+    optimizer opts out (``fused_supported = False``, e.g. SGLD's fresh
+    per-step noise), statics are unhashable, or this structure is
+    negative-cached after failed builds.
+    """
+
+    def __init__(self, updater, cache: Optional[CompileCache] = None):
+        self.updater = updater
+        self.cache = cache or CompileCache("trainer_step")
+
+    def try_step(self, updater, items) -> bool:
+        """The ONE authoritative eligibility gate shared by Trainer.step
+        and Module.update: knob enabled, the caller's updater is the
+        standard opt.Updater this FusedUpdater wraps (a swapped/custom
+        updater falls back), and the fused call itself succeeded."""
+        from . import config as _config
+        from . import optimizer as _opt
+        if not _config.get("MXNET_TPU_FUSED_TRAINER"):
+            return False
+        if type(updater) is not _opt.Updater or self.updater is not updater:
+            return False
+        return self(items)
+
+    def __call__(self, items) -> bool:
+        if not items:
+            return True
+        opt = self.updater.optimizer
+        if not getattr(opt, "fused_supported", True):
+            return False
+        states = self.updater.states
+        # lazily create states exactly like Updater.__call__ does, BEFORE
+        # signing: state structure is part of the signature
+        for idx, w, _g in items:
+            if idx not in states:
+                states[idx] = opt.create_state(idx, w)
+        try:
+            sig = self._signature(opt, items)
+        except (Uncacheable, AttributeError, TypeError):
+            # unhashable statics, or state trees with leaves the fused
+            # layer doesn't model (custom optimizers) — per-param path
+            return False
+        if self.cache.should_skip(sig):
+            return False
+
+        counts = [
+            opt._index_update_count.get(idx, opt.begin_num_update) + 1
+            for idx, _w, _g in items
+        ]
+        t_step = max(counts)
+        # replicate the eager per-param lr sequence EXACTLY: update() reads
+        # the scheduler at the CURRENT num_update and only then advances it,
+        # so at a schedule boundary the first param of the step still sees
+        # the old lr while later params see the new one
+        if opt.lr_scheduler is not None:
+            base_lrs = []
+            num_update = opt.num_update
+            for cnt in counts:
+                base_lrs.append(float(opt.lr_scheduler(num_update)))
+                num_update = max(num_update, cnt)
+        else:
+            base_lrs = [float(opt.lr)] * len(counts)
+        # python scalars throughout (lists = pytrees of scalar leaves):
+        # they trace as WEAK-typed scalars, so f16 weights stay f16 under
+        # `w - lr*g` exactly like the eager path's python-float hypers —
+        # a strong f32 array here would silently promote every f16
+        # param/state to f32 on the first fused step
+        hypers = {
+            "lrs": base_lrs,
+            "wd": float(opt.wd),
+            "rescale_grad": float(opt.rescale_grad),
+            "ts": [int(c) for c in counts],
+        }
+        if opt._clip_active():
+            # only the positive-threshold case clips; non-positive values
+            # mean "disabled" (the eager ops' convention) and must not be
+            # lifted to a traced always-on threshold
+            hypers["clip"] = float(opt.clip_gradient)
+
+        weights = [w._data for _i, w, _g in items]
+        grads = [g._data for _i, _w, g in items]
+        states_raw = [_state_raw(states[idx]) for idx, _w, _g in items]
+
+        # recording off around the trace: a step() issued inside
+        # autograd.record() must not spill tracer-valued update ops onto
+        # the global tape (lazy import: autograd imports this module)
+        from . import autograd as _autograd
+        donate = jax.default_backend() != "cpu"
+        prev_rec = _autograd.set_recording(False)
+        try:
+            runner = self.cache.get(sig)
+            if runner is None:
+                runner = self._build_runner(
+                    opt, [idx for idx, _w, _g in items], donate)
+                call_w, call_s = weights, states_raw
+                if donate:
+                    # first (compiling) call runs on COPIES: if it fails,
+                    # the donated copies are what got invalidated and the
+                    # live weight/state buffers stay valid for the eager
+                    # fallback; on success the copies' outputs replace the
+                    # originals below anyway
+                    import jax.numpy as jnp
+                    call_w = [jnp.copy(w) for w in weights]
+                    call_s = jax.tree_util.tree_map(jnp.copy, states_raw)
+                try:
+                    new_ws, new_ss = runner(call_w, grads, call_s, hypers)
+                except Exception as e:                     # noqa: BLE001
+                    self.cache.mark_failed(sig,
+                                           permanent=structural_failure(e))
+                    if _is_uncacheable(e):
+                        # structure-independent refusal (impure update()):
+                        # a per-sig negative cache can't help when the
+                        # evolving attr lands in the sig itself — pin the
+                        # INSTANCE so later steps skip without re-tracing
+                        opt.fused_supported = False
+                    return False
+                self.cache.put(sig, runner)
+            else:
+                try:
+                    new_ws, new_ss = runner(weights, grads, states_raw,
+                                            hypers)
+                    self.cache.note_success(sig)
+                except Exception as e:                     # noqa: BLE001
+                    self.cache.mark_failed(sig,
+                                           permanent=structural_failure(e))
+                    if donate:
+                        # the live buffers were donated to the failed
+                        # execution and may be invalid — there is no safe
+                        # eager fallback; surface the failure loudly
+                        raise
+                    return False
+        finally:
+            _autograd.set_recording(prev_rec)
+
+        for (idx, w, _g), nw, ns, cnt in zip(items, new_ws, new_ss, counts):
+            w._data = nw
+            w._version += 1
+            states[idx] = _commit_state(states[idx], ns)
+            opt._index_update_count[idx] = cnt
+        opt.num_update = max(opt.num_update, t_step)
+        return True
+
+    def _signature(self, opt, items):
+        per_param = []
+        for idx, w, g in items:
+            per_param.append((
+                static_key(idx),
+                aval_key(w._data),
+                aval_key(g._data),
+                _state_sig(self.updater.states[idx]),
+                opt._resolve_mult(opt.lr_mult, idx),
+                opt._resolve_mult(opt.wd_mult, idx),
+            ))
+        return (opt._fused_static_key(), tuple(per_param))
+
+    @staticmethod
+    def _build_runner(opt, indices, donate):
+        def step_fn(weights, grads, states, hypers):
+            return opt.update_fused(indices, weights, grads, states, hypers)
+
+        if donate:
+            # in-place HBM update; old buffers die with the rebind below.
+            # (CPU XLA ignores donation and warns, so skip it there.)
+            return jax.jit(step_fn, donate_argnums=(0, 2))
+        return jax.jit(step_fn)

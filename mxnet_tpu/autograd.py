@@ -170,16 +170,15 @@ def mark_variables(variables, gradients, grad_reqs="write") -> None:
         s.marked[var._uid] = weakref.ref(var)
 
 
-_BWD_CACHE: dict = {}
-_BWD_CACHE_MAX = 128
+# Structure-keyed compile cache shared with the fused optimizer step —
+# one signature scheme for both hot paths (mxnet_tpu/_fused.py).
+from ._fused import (CompileCache as _CompileCache,       # noqa: E402
+                     Uncacheable as _Uncacheable,
+                     op_identity as _op_identity,
+                     static_key as _static_key_shared,
+                     structural_failure as _structural_failure)
 
-
-_FAILED = object()     # negative-cache sentinel
-
-
-class _Uncacheable(Exception):
-    """Tape cannot use the compiled path; backward falls back to the
-    eager replay."""
+_BWD_CACHE = _CompileCache("backward", max_entries=128)
 
 
 def _is_jax_value(v):
@@ -195,23 +194,16 @@ def _compiled_backward(used, seed_keys, head_keys, primals, cts_in):
     structure and operand shapes/dtypes share one compiled program
     regardless of the concrete arrays involved — the repeated-structure
     training loop compiles once and afterwards costs one dispatch.
+
+    Op identity in the signature comes from the shared scheme
+    (_fused.op_identity): registry ops key by name, closure-backed
+    cached-op jits fold in a per-fn token, and per-call Function ops are
+    uncacheable — two same-shaped Function instances must never replay
+    each other's compiled program.
     """
     import numpy as _np
 
-    def _static_key(v):
-        """Cache-key form of a static constant — must be COLLISION-FREE:
-        array-likes go through the dynamic path instead (repr of a large
-        numpy array truncates, which would alias two different tapes
-        onto one compiled closure with a stale baked-in constant), and
-        anything else unhashable beyond plain list/tuple nesting makes
-        the tape uncacheable (eager fallback)."""
-        if isinstance(v, (list, tuple)):
-            return tuple(_static_key(x) for x in v)
-        try:
-            hash(v)
-            return v
-        except TypeError:
-            raise _Uncacheable(str(type(v)))
+    _static_key = _static_key_shared
 
     key_index = {k: i for i, k in enumerate(seed_keys)}
     dyn_vals: List = []
@@ -250,7 +242,7 @@ def _compiled_backward(used, seed_keys, head_keys, primals, cts_in):
         plan.append((e.op.fn, tuple(slots), tuple(attr_static),
                      tuple(attr_dyn), tuple(outs_idx)))
         sig_entries.append((
-            e.op.name, tuple(sig_slots),
+            _op_identity(e.op), tuple(sig_slots),
             tuple((n, _static_key(v)) for n, v in attr_static),
             tuple(attr_dyn), tuple(outs_idx)))
     head_slots = tuple(key_index[h] for h in head_keys)
@@ -264,12 +256,13 @@ def _compiled_backward(used, seed_keys, head_keys, primals, cts_in):
            tuple(aval(c) if c is not None else None
                  for c in (cts_in or [])) if cts_in is not None else None)
 
-    runner = _BWD_CACHE.get(sig)
-    if runner is _FAILED:
-        # negative cache: this structure failed to trace once — don't
-        # pay a full re-trace on every subsequent step just to fall
-        # back again
+    if _BWD_CACHE.should_skip(sig):
+        # negative cache with bounded retry: structurally untraceable
+        # sigs are pinned to eager permanently; transient failures get a
+        # few re-trace attempts before giving up (a single flaky failure
+        # must not demote a structure to per-op dispatch forever)
         raise _Uncacheable("structure previously failed to compile")
+    runner = _BWD_CACHE.get(sig)
     if runner is None:
         def fwd(seed_vals, dyn):
             env = [None] * env_size
@@ -303,14 +296,11 @@ def _compiled_backward(used, seed_keys, head_keys, primals, cts_in):
         # the cache would re-trace + fail on every later step)
         try:
             out = runner(list(primals), dyn_vals, cts_in)
-        except Exception:
-            if len(_BWD_CACHE) >= _BWD_CACHE_MAX:
-                _BWD_CACHE.pop(next(iter(_BWD_CACHE)))
-            _BWD_CACHE[sig] = _FAILED
+        except Exception as e:
+            _BWD_CACHE.mark_failed(sig,
+                                   permanent=_structural_failure(e))
             raise
-        if len(_BWD_CACHE) >= _BWD_CACHE_MAX:
-            _BWD_CACHE.pop(next(iter(_BWD_CACHE)))
-        _BWD_CACHE[sig] = runner
+        _BWD_CACHE.put(sig, runner)
         return out
 
     return runner(list(primals), dyn_vals, cts_in)

@@ -93,6 +93,15 @@ register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
 register("MXNET_USE_NATIVE_IO", _parse_bool, True,
          "use the C++ data path (libmxnative: RecordIO codec, jpeg/png "
          "decode, threaded augment pipeline); 0 = pure-Python/cv2 path")
+register("MXNET_TPU_FUSED_TRAINER", _parse_bool, True,
+         "gluon Trainer.step / Module.update: batch all parameter updates "
+         "into one structure-cached, donated jitted program; 0 = eager "
+         "per-param dispatch")
+register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
+         "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
+         "one-pass E[x^2]-E[x]^2 form — restores precision for "
+         "large-offset activations at one extra read of x (takes effect "
+         "on the next trace; already-compiled programs keep their form)")
 
 
 def get(name: str):

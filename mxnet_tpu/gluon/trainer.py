@@ -11,6 +11,7 @@ directly; a ``dist`` kvstore routes through push/pull for API parity.
 from __future__ import annotations
 
 from .. import optimizer as opt
+from .. import _fused
 from ..model import _create_kvstore
 from .parameter import ParameterDict, Parameter
 
@@ -59,6 +60,7 @@ class Trainer(object):
         self._optimizer.lr_mult = {p.name: p.lr_mult for p in self._params}
         self._optimizer.wd_mult = {p.name: p.wd_mult for p in self._params}
         self._updaters = opt.get_updater(self._optimizer)
+        self._fused_step = _fused.FusedUpdater(self._updaters)
 
     def _init_kvstore(self):
         arg_arrays = {p.name: p.data() for p in self._params}
@@ -104,10 +106,18 @@ class Trainer(object):
                 else:
                     self._kvstore.pull(i, out=param.grad())
                     self._updaters(i, param.grad(), param.data())
-        else:
-            for i in live:
-                self._updaters(i, self._params[i].grad(),
-                               self._params[i].data())
+            return
+
+        # fused fast path: every live (weight, grad, state) triple in ONE
+        # structure-cached, donated jitted program — per-param fallback
+        # when disabled, the updater was swapped for a custom one, or the
+        # optimizer/structure can't fuse (e.g. SGLD's per-step noise)
+        items = [(i, self._params[i].data(), self._params[i].grad())
+                 for i in live]
+        if self._fused_step.try_step(self._updaters, items):
+            return
+        for i, weight, grad in items:
+            self._updaters(i, grad, weight)
 
     def save_states(self, fname):
         """(reference: trainer.py save_states)."""

@@ -29,6 +29,8 @@ from .. import optimizer as opt
 from ..executor import Executor, graph_function
 from ..initializer import InitDesc
 from ..model import _create_kvstore, load_checkpoint, save_checkpoint
+from .. import config as _config
+from .. import _fused
 from .base_module import BaseModule, _check_input_names
 from ..io.io import DataDesc
 
@@ -94,6 +96,7 @@ class Module(BaseModule):
         self._kvstore = None
         self._update_on_kvstore = None
         self._updater = None
+        self._fused_updater = None
         self._preload_opt_states = None
 
         self._exec: Optional[Executor] = None
@@ -377,6 +380,7 @@ class Module(BaseModule):
                 kvstore.set_optimizer(self._optimizer)
         if not update_on_kvstore:
             self._updater = opt.get_updater(optimizer)
+            self._fused_updater = _fused.FusedUpdater(self._updater)
 
         self.optimizer_initialized = True
         self._build_fused_step()
@@ -392,6 +396,7 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._fused_updater = shared_module._fused_updater
         self.optimizer_initialized = True
         self._build_fused_step()
 
@@ -644,11 +649,20 @@ class Module(BaseModule):
                     self._kvstore.pull(idx, out=grad)
                     self._updater(idx, grad, weight)
         else:
+            items = []
             for idx, name in enumerate(self._param_names):
                 grad = self._exec.grad_dict.get(name)
                 if grad is None:
                     continue
-                self._updater(idx, grad, self._exec.arg_dict[name])
+                items.append((idx, self._exec.arg_dict[name], grad))
+            # same fused whole-model step as gluon Trainer.step: all
+            # updates in one structure-cached jitted program, per-param
+            # eager dispatch as the fallback
+            if self._fused_updater is not None \
+                    and self._fused_updater.try_step(self._updater, items):
+                return
+            for idx, weight, grad in items:
+                self._updater(idx, grad, weight)
 
     def get_outputs(self, merge_multi_context=True):
         """(reference: module.py get_outputs). One program ⇒ already

@@ -34,6 +34,7 @@ from jax import lax
 
 from .registry import register, OP_REGISTRY
 from .. import amp
+from .. import config as _config
 
 # ----------------------------------------------------------------- helpers
 
@@ -334,10 +335,20 @@ def _layer_norm_fwd_impl(data, gamma, beta, ax, eps):
     # two-pass E[(x-mean)^2] form whose second reduction re-reads x
     # after the mean — measured ~2 ms/step on the L12 transformer. The
     # cancellation risk is acceptable in f32 for activation-scale data
-    # (flax's use_fast_variance default does the same).
+    # (flax's use_fast_variance default does the same); models whose
+    # activations carry a large common offset can restore the two-pass
+    # form with MXNET_TPU_LAYERNORM_TWO_PASS=1.
     mean = jnp.mean(x32, axis=ax, keepdims=True)
-    msq = jnp.mean(jnp.square(x32), axis=ax, keepdims=True)
-    var = jnp.maximum(msq - jnp.square(mean), 0.0)
+    # deliberately read live instead of an on_change-cached constant:
+    # on_change only fires on config.set/reset, so a cached value would
+    # ignore env mutation after import (how every other knob behaves via
+    # config.get). Cost is one dict+environ lookup per op CALL (trace or
+    # eager), dwarfed by the reductions below — not per element.
+    if _config.get("MXNET_TPU_LAYERNORM_TWO_PASS"):
+        var = jnp.mean(jnp.square(x32 - mean), axis=ax, keepdims=True)
+    else:
+        msq = jnp.mean(jnp.square(x32), axis=ax, keepdims=True)
+        var = jnp.maximum(msq - jnp.square(mean), 0.0)
     rstd = lax.rsqrt(var + eps)
     shp = tuple(data.shape[ax] if i == ax else 1
                 for i in range(data.ndim))

@@ -18,10 +18,26 @@ from .registry import register
 __all__ = []
 
 
+import numpy as _np
+
+
+def _clip_arg(c):
+    """Normalize a clip threshold: None / non-positive concrete number ->
+    no clipping; a traced scalar (the fused trainer step lifts the clip
+    VALUE to a dynamic argument — its presence is the static part, and it
+    is only lifted when positive) is always an active threshold."""
+    if c is None:
+        return None
+    if isinstance(c, (int, float, _np.number)):
+        return c if c > 0 else None
+    return c
+
+
 def _grad_prep(weight, grad, rescale_grad, clip_gradient, wd):
     g = grad * rescale_grad
-    if clip_gradient is not None and clip_gradient > 0:
-        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    c = _clip_arg(clip_gradient)
+    if c is not None:
+        g = jnp.clip(g, -c, c)
     return g + wd * weight
 
 
@@ -30,8 +46,7 @@ def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0):
     """weight -= lr * (rescale*clip(grad) + wd*weight)
     (reference: optimizer_op.cc sgd_update)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     return weight - lr * g
 
 
@@ -40,8 +55,7 @@ def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
     """mom = momentum*mom - lr*(grad_prep); weight += mom
     (reference: optimizer_op.cc sgd_mom_update). Returns (weight, mom)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     new_mom = momentum * mom - lr * g
     return weight + new_mom, new_mom
 
@@ -50,8 +64,7 @@ def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
     """Nesterov momentum (reference: python/mxnet/optimizer.py NAG.update)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     new_mom = momentum * mom + g
     return weight - lr * (g + momentum * new_mom), new_mom
 
@@ -62,8 +75,7 @@ def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     """(reference: optimizer_op.cc adam_update). Returns (weight, mean, var);
     lr is expected already bias-corrected by the caller (as the reference's
     Adam.update does)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
     new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
@@ -75,12 +87,12 @@ def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    clip_weights=-1.0):
     """Tieleman & Hinton RMSProp (reference: optimizer_op.cc rmsprop_update)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
-    if clip_weights is not None and clip_weights > 0:
-        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    cw = _clip_arg(clip_weights)
+    if cw is not None:
+        new_w = jnp.clip(new_w, -cw, cw)
     return new_w, new_n
 
 
@@ -90,15 +102,15 @@ def rmspropalex_update(weight, grad, n, g_acc, delta, lr=0.001, gamma1=0.95,
                        clip_gradient=-1.0, clip_weights=-1.0):
     """Graves' centered RMSProp (reference: optimizer_op.cc
     rmspropalex_update). Returns (weight, n, g_acc, delta)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     new_g = (1 - gamma1) * g + gamma1 * g_acc
     new_delta = gamma2 * delta - lr * g / jnp.sqrt(
         new_n - jnp.square(new_g) + epsilon)
     new_w = weight + new_delta
-    if clip_weights is not None and clip_weights > 0:
-        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    cw = _clip_arg(clip_weights)
+    if cw is not None:
+        new_w = jnp.clip(new_w, -cw, cw)
     return new_w, new_n, new_g, new_delta
 
 
@@ -106,8 +118,7 @@ def rmspropalex_update(weight, grad, n, g_acc, delta, lr=0.001, gamma1=0.95,
 def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
     """(reference: python/mxnet/optimizer.py AdaGrad.update)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, 0.0)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, 0.0)
     new_hist = history + jnp.square(g)
     new_w = weight - lr * (g / jnp.sqrt(new_hist + epsilon) + wd * weight)
     return new_w, new_hist
@@ -117,8 +128,7 @@ def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
 def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """(reference: python/mxnet/optimizer.py AdaDelta.update)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, 0.0)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, 0.0)
     new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
     delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
     new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
@@ -130,8 +140,7 @@ def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
 def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0):
     """(reference: python/mxnet/optimizer.py Ftrl.update)."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, 0.0)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, 0.0)
     new_n = n + jnp.square(g)
     sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
     new_z = z + g - sigma * weight
@@ -148,8 +157,7 @@ def adamax_update(weight, grad, mean, u, lr=0.002, beta1=0.9, beta2=0.999,
                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """(reference: python/mxnet/optimizer.py Adamax.update); lr already
     bias-corrected by caller."""
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_u = jnp.maximum(beta2 * u, jnp.abs(g))
     return weight - lr * new_mean / new_u, new_mean, new_u
@@ -160,8 +168,7 @@ def sgld_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, _rng=None):
     """Stochastic Gradient Langevin Dynamics (reference: optimizer.py SGLD)."""
     import jax
-    g = _grad_prep(weight, grad, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd)
+    g = _grad_prep(weight, grad, rescale_grad, clip_gradient, wd)
     noise = jax.random.normal(_rng, weight.shape, weight.dtype) * \
         jnp.sqrt(jnp.asarray(lr, weight.dtype))
     return weight - lr / 2 * g + noise

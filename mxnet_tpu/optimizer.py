@@ -30,6 +30,24 @@ class Optimizer(object):
 
     opt_registry: Dict[str, type] = {}
 
+    # Safe to trace this optimizer's update into the fused whole-model
+    # step (mxnet_tpu/_fused.py FusedUpdater)? Updates that draw fresh
+    # randomness per step (SGLD) must keep the eager path — a jitted
+    # replay would bake one PRNG key into the compiled program and repeat
+    # identical noise every step.
+    fused_supported = True
+
+    # Instance attrs NOT baked into a compiled fused step: per-step
+    # dynamic hyperparameters (entering as traced scalars), per-index
+    # bookkeeping, and symbol-layer metadata. Everything else in
+    # ``__dict__`` is a static hyperparameter and keys the compile cache.
+    _FUSED_DYNAMIC_ATTRS = frozenset({
+        "lr", "wd", "rescale_grad", "clip_gradient", "lr_scheduler",
+        "lr_mult", "wd_mult", "idx2name", "sym", "num_update",
+        "begin_num_update", "_index_update_count", "_traced_lr",
+        "_traced_t", "weight_previous",
+    })
+
     @staticmethod
     def register(klass):
         """(reference: optimizer.py Optimizer.register)."""
@@ -108,6 +126,15 @@ class Optimizer(object):
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
 
+    def _resolve_mult(self, mults: Dict[Any, float], index) -> float:
+        """Per-param lr/wd multiplier lookup (index first, then mapped
+        name; reference: optimizer.py _get_lr/_get_wd)."""
+        if index in mults:
+            return mults[index]
+        if index in self.idx2name:
+            return mults.get(self.idx2name[index], 1.0)
+        return 1.0
+
     def _get_lr(self, index) -> float:
         if self._traced_lr is not None:
             lr = self._traced_lr
@@ -115,30 +142,25 @@ class Optimizer(object):
             lr = self.lr_scheduler(self.num_update)
         else:
             lr = self.lr
-        if index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        return lr * self._resolve_mult(self.lr_mult, index)
 
     def _get_wd(self, index) -> float:
-        wd = self.wd
-        if index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._resolve_mult(self.wd_mult, index)
 
-    def raw_update(self, index, weight, grad, state, lr=None, t=None):
+    def raw_update(self, index, weight, grad, state, lr=None, t=None,
+                   wd=None, rescale_grad=None, clip_gradient=None,
+                   _check_pure=False):
         """Functionally apply this optimizer's update to raw (possibly
         traced) jax arrays, returning ``(new_weight, new_state)``.
 
-        The TPU fit hot path (Module._fit_step) traces this inside ONE jitted
-        train step — the analogue of the reference running `sgd_mom_update`
-        engine ops right after the backward ops (SURVEY.md §2.5 optimizer
-        update ops, §7 "fit() must run fully jitted"). ``lr`` and the update
-        count ``t`` enter as traced scalars so LR schedules and Adam bias
-        correction do not force a recompile every step.
+        The TPU fit hot path (Module._fit_step) and the fused trainer step
+        (mxnet_tpu/_fused.py) trace this inside ONE jitted program — the
+        analogue of the reference running `sgd_mom_update` engine ops right
+        after the backward ops (SURVEY.md §2.5 optimizer update ops, §7
+        "fit() must run fully jitted"). ``lr``, the update count ``t``, and
+        the optional ``wd``/``rescale_grad``/``clip_gradient`` overrides
+        enter as traced scalars so LR schedules, weight-decay changes and
+        batch-size changes do not force a recompile every step.
         """
         from .ndarray import NDArray
 
@@ -157,25 +179,149 @@ class Optimizer(object):
             return v._data
 
         w, g, s = NDArray(weight), NDArray(grad), wrap(state)
-        self._traced_lr, self._traced_t = lr, t
         # snapshot ALL instance attrs: a traced update() must not leak
         # tracers into persistent optimizer state (state flows through the
         # returned pytree instead)
         saved = {k: (dict(v) if isinstance(v, dict) else v)
                  for k, v in self.__dict__.items()}
+        self._traced_lr, self._traced_t = lr, t
+        if wd is not None:
+            self.wd = wd
+        if rescale_grad is not None:
+            self.rescale_grad = rescale_grad
+        if clip_gradient is not None and self.clip_gradient is not None:
+            # only the VALUE is dynamic; clip presence is structural
+            self.clip_gradient = clip_gradient
         try:
             self.update(index, w, g, s)
+            if _check_pure:
+                # the snapshot/restore below DISCARDS any instance-attr
+                # mutation update() made beyond the sanctioned dynamic/
+                # bookkeeping set — an optimizer keeping per-step state on
+                # the instance (reference-style warmup counters, schedule
+                # accumulators) would silently train with a frozen value,
+                # so the fused replay refuses it (eager path instead)
+                self._check_update_purity(saved)
         finally:
             self.__dict__.clear()
             self.__dict__.update(saved)
-            self._traced_lr = self._traced_t = None
         return w._data, unwrap(s)
+
+    def _check_update_purity(self, saved):
+        """Raise Uncacheable if update() rebound or mutated any instance
+        attr outside _FUSED_DYNAMIC_ATTRS (whose per-step values are
+        threaded dynamically or restored by design). Conservative: any
+        non-scalar rebinding counts as a mutation."""
+        from ._fused import Uncacheable
+
+        def same(a, b):
+            if a is b:
+                return True
+            if a is None or isinstance(a, (bool, int, float, str, bytes)):
+                return type(a) is type(b) and a == b
+            return False
+
+        sanctioned = self._FUSED_DYNAMIC_ATTRS
+        if set(self.__dict__) != set(saved):
+            raise Uncacheable("update() added/removed instance attrs")
+        for k, old in saved.items():
+            if k in sanctioned:
+                continue
+            cur = self.__dict__[k]
+            if isinstance(old, dict):
+                if not isinstance(cur, dict) or set(cur) != set(old) or \
+                        any(not same(old[dk], cur[dk]) for dk in old):
+                    raise Uncacheable(
+                        "update() mutated optimizer attr %s" % k)
+            elif not same(old, cur):
+                raise Uncacheable("update() mutated optimizer attr %s" % k)
 
     def _common_kwargs(self, index):
         kw = {"rescale_grad": self.rescale_grad}
         if self.clip_gradient is not None:
             kw["clip_gradient"] = self.clip_gradient
         return kw
+
+    # --------------------------------------------------- fused step form
+    def _clip_active(self) -> bool:
+        """Whether gradient clipping actually fires: the eager update ops
+        treat None AND non-positive thresholds as disabled, so only a
+        positive value is lifted to a dynamic traced threshold."""
+        return self.clip_gradient is not None and self.clip_gradient > 0
+
+    def _fused_static_key(self):
+        """Hashable tuple of every attr a compiled fused step bakes in
+        (per-step dynamic hypers and bookkeeping excluded). Must be
+        collision-free: unhashable statics make the optimizer unfusable
+        rather than risk aliasing two configurations onto one program."""
+        from ._fused import Uncacheable
+
+        def value_key(v, name):
+            # value-hashable types only: objects with identity-based
+            # hashes (NDArray, arbitrary instances) would alias a stale
+            # baked constant after in-place mutation — those make the
+            # optimizer unfusable instead
+            if v is None or isinstance(v, (bool, int, float, str, bytes)):
+                return v
+            if isinstance(v, tuple):
+                return tuple(value_key(x, name) for x in v)
+            raise Uncacheable("non-value-hashable optimizer attr %s" % name)
+
+        items = []
+        for k in sorted(self.__dict__):
+            if k in self._FUSED_DYNAMIC_ATTRS:
+                continue
+            items.append((k, value_key(self.__dict__[k], k)))
+        # clip structure: an ACTIVE threshold is lifted to a dynamic arg
+        # ("dyn"); an inactive one (None or non-positive) is baked into the
+        # traced program (python-side optimizers branch on `is not None`),
+        # so its concrete value must key the cache to avoid aliasing the
+        # no-clip program with a baked-disabled-clip program
+        clip_key = "dyn" if self._clip_active() else self.clip_gradient
+        return (type(self).__module__ + "." + type(self).__qualname__,
+                clip_key, tuple(items))
+
+    def _fused_hypers(self, pos, index, hypers):
+        """Per-param (lr, wd) from the dynamic base scalars + static
+        multipliers — the traced twin of _get_lr/_get_wd. ``lrs`` is one
+        base lr per param so the scheduler's eager read-then-advance
+        sequence is reproduced exactly."""
+        return (hypers["lrs"][pos] * self._resolve_mult(self.lr_mult, index),
+                hypers["wd"] * self._resolve_mult(self.wd_mult, index))
+
+    def _fused_common(self, hypers):
+        kw = {"rescale_grad": hypers["rescale_grad"]}
+        if "clip" in hypers:
+            kw["clip_gradient"] = hypers["clip"]
+        return kw
+
+    def update_fused(self, indices, weights, grads, states, hypers):
+        """Pure functional whole-model step — the tree-map form of the
+        per-index :meth:`update`, traced into ONE XLA program by
+        ``FusedUpdater``. ``weights``/``grads`` are lists of raw jax
+        arrays, ``states`` a list of raw-array pytrees, ``hypers`` the
+        dynamic scalars (``lr``, ``wd``, ``rescale_grad``, optional
+        ``clip``, and per-param update counts ``ts``). Returns
+        ``(new_weights, new_states)``; :meth:`update` remains the
+        reference semantics the parity suite checks against."""
+        new_ws, new_ss = [], []
+        for pos, idx in enumerate(indices):
+            nw, ns = self._fused_one(pos, idx, weights[pos], grads[pos],
+                                     states[pos], hypers)
+            new_ws.append(nw)
+            new_ss.append(ns)
+        return new_ws, new_ss
+
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        """Single-param functional update. The base form replays the
+        eager :meth:`update` under the trace via :meth:`raw_update`
+        (exact parity by construction, covers custom subclasses);
+        built-ins override with direct calls into the same update ops."""
+        return self.raw_update(
+            idx, weight, grad, state, lr=hypers["lrs"][pos],
+            t=hypers["ts"][pos], wd=hypers["wd"],
+            rescale_grad=hypers["rescale_grad"],
+            clip_gradient=hypers.get("clip"), _check_pure=True)
 
 
 register = Optimizer.register
@@ -234,6 +380,22 @@ class SGD(Optimizer):
             weight._data = w.data.astype(weight.dtype)
             weight._version += 1
 
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        lr, wd = self._fused_hypers(pos, idx, hypers)
+        kw = self._fused_common(hypers)
+        mom, master = state if isinstance(state, tuple) else (state, None)
+        w = master if master is not None else weight
+        g = grad.astype(w.dtype) if grad.dtype != w.dtype else grad
+        if self.momentum == 0.0:
+            new_w = get_op("sgd_update").fn(w, g, lr=lr, wd=wd, **kw)
+            new_mom = None
+        else:
+            new_w, new_mom = get_op("sgd_mom_update").fn(
+                w, g, mom, lr=lr, wd=wd, momentum=self.momentum, **kw)
+        if master is not None:
+            return new_w.astype(weight.dtype), (new_mom, new_w)
+        return new_w, new_mom
+
 
 @register
 class NAG(Optimizer):
@@ -258,10 +420,23 @@ class NAG(Optimizer):
             _invoke("nag_mom_update", [weight, grad, state], [weight, state],
                     lr=lr, wd=wd, momentum=self.momentum, **kw)
 
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        lr, wd = self._fused_hypers(pos, idx, hypers)
+        kw = self._fused_common(hypers)
+        if state is None:
+            return get_op("sgd_update").fn(weight, grad, lr=lr, wd=wd,
+                                           **kw), None
+        return get_op("nag_mom_update").fn(
+            weight, grad, state, lr=lr, wd=wd, momentum=self.momentum, **kw)
+
 
 @register
 class SGLD(Optimizer):
     """Langevin dynamics sampler (reference: optimizer.py SGLD)."""
+
+    # fresh Langevin noise every step: a compiled replay would bake one
+    # PRNG key and repeat the same noise — keep the eager per-param path
+    fused_supported = False
 
     def update(self, index, weight, grad, state):
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -330,6 +505,17 @@ class Adam(Optimizer):
                 lr=lr, beta1=self.beta1, beta2=self.beta2,
                 epsilon=self.epsilon, wd=wd, **self._common_kwargs(index))
 
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        lr, wd = self._fused_hypers(pos, idx, hypers)
+        t = hypers["ts"][pos]
+        lr = lr * (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        mean, var = state
+        new_w, new_mean, new_var = get_op("adam_update").fn(
+            weight, grad, mean, var, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            **self._fused_common(hypers))
+        return new_w, (new_mean, new_var)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -348,6 +534,12 @@ class AdaGrad(Optimizer):
         _invoke("adagrad_update", [weight, grad, state], [weight, state],
                 lr=lr, wd=wd, epsilon=self.float_stable_eps,
                 **self._common_kwargs(index))
+
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        lr, wd = self._fused_hypers(pos, idx, hypers)
+        return get_op("adagrad_update").fn(
+            weight, grad, state, lr=lr, wd=wd,
+            epsilon=self.float_stable_eps, **self._fused_common(hypers))
 
 
 @register
@@ -386,6 +578,21 @@ class RMSProp(Optimizer):
                     lr=lr, gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
                     **kw)
 
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        lr, wd = self._fused_hypers(pos, idx, hypers)
+        kw = self._fused_common(hypers)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g_acc, delta = state
+            new_w, new_n, new_g, new_d = get_op("rmspropalex_update").fn(
+                weight, grad, n, g_acc, delta, lr=lr, gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon, wd=wd, **kw)
+            return new_w, (new_n, new_g, new_d)
+        return get_op("rmsprop_update").fn(
+            weight, grad, state, lr=lr, gamma1=self.gamma1,
+            epsilon=self.epsilon, wd=wd, **kw)
+
 
 @register
 class AdaDelta(Optimizer):
@@ -407,6 +614,14 @@ class AdaDelta(Optimizer):
                 [weight, acc_g, acc_delta], rho=self.rho,
                 epsilon=self.epsilon, wd=wd, **self._common_kwargs(index))
 
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        _lr, wd = self._fused_hypers(pos, idx, hypers)
+        acc_g, acc_delta = state
+        new_w, new_g, new_d = get_op("adadelta_update").fn(
+            weight, grad, acc_g, acc_delta, rho=self.rho,
+            epsilon=self.epsilon, wd=wd, **self._fused_common(hypers))
+        return new_w, (new_g, new_d)
+
 
 @register
 class Ftrl(Optimizer):
@@ -427,6 +642,14 @@ class Ftrl(Optimizer):
         _invoke("ftrl_update", [weight, grad, z, n], [weight, z, n],
                 lr=lr, lamda1=self.lamda1, beta=self.beta, wd=wd,
                 **self._common_kwargs(index))
+
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        lr, wd = self._fused_hypers(pos, idx, hypers)
+        z, n = state
+        new_w, new_z, new_n = get_op("ftrl_update").fn(
+            weight, grad, z, n, lr=lr, lamda1=self.lamda1, beta=self.beta,
+            wd=wd, **self._fused_common(hypers))
+        return new_w, (new_z, new_n)
 
 
 @register
@@ -450,6 +673,16 @@ class Adamax(Optimizer):
         _invoke("adamax_update", [weight, grad, mean, u], [weight, mean, u],
                 lr=lr, beta1=self.beta1, beta2=self.beta2, wd=wd,
                 **self._common_kwargs(index))
+
+    def _fused_one(self, pos, idx, weight, grad, state, hypers):
+        lr, wd = self._fused_hypers(pos, idx, hypers)
+        t = hypers["ts"][pos]
+        lr = lr / (1.0 - self.beta1 ** t)
+        mean, u = state
+        new_w, new_mean, new_u = get_op("adamax_update").fn(
+            weight, grad, mean, u, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, wd=wd, **self._fused_common(hypers))
+        return new_w, (new_mean, new_u)
 
 
 @register
@@ -528,22 +761,65 @@ class Updater(object):
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def set_states(self, states: bytes):
-        self.states = pickle.loads(states)
+        # NDArray leaves were serialized as tagged numpy (get_states);
+        # rewrap exactly those so in-place update commits (eager) and the
+        # fused step's state threading both keep working after a load,
+        # while genuinely-numpy custom state passes through untouched.
+        # Legacy blobs (untagged dicts from older checkpoints) rewrap
+        # every numpy leaf — the pre-tagging best effort.
+        payload = pickle.loads(states)
+        legacy = not (isinstance(payload, dict)
+                      and "__nd_tagged__" in payload)
+        if not legacy:
+            payload = payload["states"]
+        self.states = {k: _state_from_np(v, legacy)
+                       for k, v in payload.items()}
 
     def get_states(self) -> bytes:
         states = {}
         for k, v in self.states.items():
             states[k] = _state_to_np(v)
-        return pickle.dumps(states)
+        return pickle.dumps({"__nd_tagged__": 1, "states": states})
+
+
+class _NDTag(object):
+    """Marks a pickled numpy leaf as having been an NDArray before
+    serialization, so deserialization rewraps exactly those."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __getstate__(self):
+        return self.value
+
+    def __setstate__(self, value):
+        self.value = value
 
 
 def _state_to_np(v):
     if v is None:
         return None
     if isinstance(v, NDArray):
-        return v.asnumpy()
+        return _NDTag(v.asnumpy())
     if isinstance(v, tuple):
         return tuple(_state_to_np(x) for x in v)
+    return v
+
+
+def _state_from_np(v, legacy=False):
+    """Inverse of _state_to_np: rewrap exactly the leaves tagged as
+    NDArray at serialization time; any other leaf (custom optimizer
+    state: raw numpy, scalars, dicts, ...) passes through untouched.
+    ``legacy`` (pre-tag checkpoint blobs) rewraps untagged numpy leaves
+    as a best effort — built-in optimizer states were always NDArray."""
+    if isinstance(v, tuple):
+        return tuple(_state_from_np(x, legacy) for x in v)
+    if isinstance(v, _NDTag) or (legacy and isinstance(v, np.ndarray)):
+        import jax.numpy as jnp
+        raw = v.value if isinstance(v, _NDTag) else v
+        return NDArray(jnp.asarray(raw))
     return v
 
 

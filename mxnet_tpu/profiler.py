@@ -27,12 +27,14 @@ __all__ = [
     "profiler_set_config", "profiler_set_state", "dump_profile",
     "set_config", "set_state", "dump", "pause", "resume",
     "start_xla_trace", "stop_xla_trace", "record_event", "state",
+    "incr_counter", "get_counter", "counters", "reset_counters",
 ]
 
 _lock = threading.Lock()
 _state = "stop"
 _filename = "profile.json"
 _events: List[dict] = []
+_counters: dict = {}
 _t0 = time.perf_counter()
 
 
@@ -75,6 +77,35 @@ def record_event(name: str, t_start: float, t_end: float,
             "ts": (t_start - _t0) * 1e6, "dur": (t_end - t_start) * 1e6,
             "pid": 0, "tid": threading.get_ident() % 100000,
         })
+
+
+# ------------------------------------------------------------- counters
+# Always-on framework counters (compile-cache hits/misses and friends —
+# the TPU twin of the reference engine's aggregate stats). Unlike trace
+# events these are cheap enough to count unconditionally, so tests can
+# assert e.g. "one compiled executable per trainer step after warmup"
+# without enabling tracing.
+
+
+def incr_counter(name: str, n: int = 1) -> None:
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def get_counter(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def counters() -> dict:
+    """Snapshot of all counters."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counters.clear()
 
 
 class record(object):

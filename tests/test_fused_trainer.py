@@ -1,0 +1,455 @@
+"""Fused whole-model optimizer step (mxnet_tpu/_fused.py).
+
+Covers:
+
+* parity: fused ``Trainer.step`` == eager per-param path, for every
+  built-in optimizer x {plain, clip_gradient, wd, lr_mult/wd_mult,
+  null-grad param riding along}, over >= 3 steps;
+* cache behavior: LR-schedule / wd / batch-size changes do NOT recompile,
+  shape changes do; exactly one compiled executable dispatched per step
+  after warmup (profiler compile/hit counters);
+* fallback matrix: SGLD (fresh per-step noise) keeps the eager path;
+* the shared-cache bugfixes: closure-backed OpDef signature collision
+  (Scale(2.0)/Scale(3.0) repro) and bounded-retry negative caching;
+* the MXNET_TPU_LAYERNORM_TWO_PASS escape hatch;
+* Module.update() riding the same fused layer.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _fused, autograd, gluon, profiler
+from mxnet_tpu import config as cfg
+from mxnet_tpu import optimizer as opt
+
+
+def _make_params(shapes, seed=0, mults=False, with_null=False):
+    rng = np.random.RandomState(seed)
+    params = []
+    for i, shp in enumerate(shapes):
+        p = gluon.Parameter("p%d_weight" % i, shape=shp)
+        if mults and i == 0:
+            p.lr_mult, p.wd_mult = 0.5, 2.0
+        p.initialize()
+        p.set_data(mx.nd.array(rng.randn(*shp).astype(np.float32)))
+        params.append(p)
+    if with_null:
+        p = gluon.Parameter("frozen_weight", shape=(3,), grad_req="null")
+        p.initialize()
+        p.set_data(mx.nd.array(np.ones(3, np.float32)))
+        params.append(p)
+    return params
+
+
+def _run_steps(opt_name, opt_kwargs, fused, steps=3, mults=False,
+               with_null=True, shapes=((4, 5), (7,), (2, 3, 2))):
+    cfg.set("MXNET_TPU_FUSED_TRAINER", fused)
+    try:
+        params = _make_params(shapes, mults=mults, with_null=with_null)
+        live = [p for p in params if p.grad_req != "null"]
+        kw = dict(opt_kwargs)
+        kw.setdefault("learning_rate", 0.1)
+        trainer = gluon.Trainer(params, opt_name, kw)
+        rng = np.random.RandomState(99)
+        for _ in range(steps):
+            for p in live:
+                p.grad()[:] = mx.nd.array(
+                    rng.randn(*p.shape).astype(np.float32))
+            trainer.step(batch_size=2)
+        return [p.data().asnumpy() for p in params], trainer
+    finally:
+        cfg.reset("MXNET_TPU_FUSED_TRAINER")
+
+
+OPTIMIZERS = [
+    ("sgd", {}),
+    ("sgd", {"momentum": 0.9}),
+    ("nag", {"momentum": 0.9}),
+    ("adam", {}),
+    ("adagrad", {}),
+    ("rmsprop", {}),
+    ("rmsprop", {"centered": True}),
+    ("adadelta", {}),
+    ("ftrl", {}),
+    ("adamax", {}),
+    ("nadam", {}),
+    ("dcasgd", {"momentum": 0.9}),
+    ("test", {}),
+]
+
+VARIANTS = [
+    {},
+    {"clip_gradient": 0.05},
+    # non-positive threshold means "clipping disabled" in the eager ops;
+    # the fused path must not lift it to an always-on traced threshold
+    {"clip_gradient": -1.0},
+    {"wd": 0.01},
+]
+
+
+@pytest.mark.parametrize("opt_name,opt_kwargs",
+                         OPTIMIZERS, ids=lambda v: str(v))
+def test_fused_parity(opt_name, opt_kwargs):
+    for variant in VARIANTS:
+        kw = dict(opt_kwargs, **variant)
+        c0 = profiler.get_counter("trainer_step_compile")
+        h0 = profiler.get_counter("trainer_step_cache_hit")
+        got, _ = _run_steps(opt_name, kw, fused=True)
+        # engaged every step: one compile OR hit per step (the wd variant
+        # legitimately HITS the plain variant's program — wd is dynamic)
+        fused_calls = (profiler.get_counter("trainer_step_compile") - c0 +
+                       profiler.get_counter("trainer_step_cache_hit") - h0)
+        assert fused_calls == 3, \
+            "fused path did not engage for %s %s" % (opt_name, kw)
+        want, _ = _run_steps(opt_name, kw, fused=False)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-6)
+
+
+def test_fused_parity_lr_scheduler_boundary():
+    """The eager loop reads the scheduler BEFORE advancing num_update, so
+    at a boundary the step's first param still sees the old lr; the fused
+    per-param lr vector must reproduce that sequence exactly."""
+    from mxnet_tpu import lr_scheduler
+
+    def run(fused):
+        cfg.set("MXNET_TPU_FUSED_TRAINER", fused)
+        try:
+            params = _make_params([(4, 3), (6,)], seed=11)
+            sched = lr_scheduler.MultiFactorScheduler(step=[2, 4],
+                                                      factor=0.5)
+            trainer = gluon.Trainer(
+                params, "sgd", {"learning_rate": 0.2, "momentum": 0.9,
+                                "lr_scheduler": sched})
+            rng = np.random.RandomState(7)
+            for _ in range(6):
+                for p in params:
+                    p.grad()[:] = mx.nd.array(
+                        rng.randn(*p.shape).astype(np.float32))
+                trainer.step(batch_size=2)
+            return [p.data().asnumpy() for p in params]
+        finally:
+            cfg.reset("MXNET_TPU_FUSED_TRAINER")
+
+    for g, w in zip(run(True), run(False)):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-6)
+
+
+def test_fused_parity_lr_wd_mult():
+    got, _ = _run_steps("sgd", {"momentum": 0.9, "wd": 0.01}, fused=True,
+                        mults=True)
+    want, _ = _run_steps("sgd", {"momentum": 0.9, "wd": 0.01}, fused=False,
+                         mults=True)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-6)
+
+
+def test_fused_parity_multi_precision_sgd():
+    def run(fused):
+        cfg.set("MXNET_TPU_FUSED_TRAINER", fused)
+        try:
+            rng = np.random.RandomState(0)
+            p = gluon.Parameter("w_weight", shape=(8, 4), dtype=np.float16)
+            p.initialize()
+            p.set_data(mx.nd.array(rng.randn(8, 4).astype(np.float16)))
+            trainer = gluon.Trainer(
+                [p], "sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                             "multi_precision": True})
+            for _ in range(3):
+                p.grad()[:] = mx.nd.array(
+                    rng.randn(8, 4).astype(np.float16))
+                trainer.step(2)
+            return p.data().asnumpy()
+        finally:
+            cfg.reset("MXNET_TPU_FUSED_TRAINER")
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-3, atol=1e-3)
+
+
+def test_fused_keeps_f16_dtype_without_multi_precision():
+    """Hypers enter as weak-typed python scalars: f16 weights/states must
+    stay f16 through the fused step (a strong f32 lr array would promote
+    them and recompile every step)."""
+    cfg.set("MXNET_TPU_FUSED_TRAINER", True)
+    try:
+        p = gluon.Parameter("w_weight", shape=(4, 4), dtype=np.float16)
+        p.initialize()
+        trainer = gluon.Trainer([p], "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        c0 = profiler.get_counter("trainer_step_compile")
+        for _ in range(3):
+            p.grad()[:] = mx.nd.array(np.ones((4, 4), np.float16))
+            trainer.step(2)
+        assert p.data().dtype == np.float16
+        mom = trainer._updaters.states[0]
+        assert mom.dtype == np.float16
+        assert profiler.get_counter("trainer_step_compile") == c0 + 1
+    finally:
+        cfg.reset("MXNET_TPU_FUSED_TRAINER")
+
+
+def test_fused_matches_update_counts_and_states():
+    _, tr_f = _run_steps("adam", {}, fused=True)
+    _, tr_e = _run_steps("adam", {}, fused=False)
+    assert tr_f._optimizer.num_update == tr_e._optimizer.num_update == 3
+    assert tr_f._optimizer._index_update_count == \
+        tr_e._optimizer._index_update_count
+    sf, se = tr_f._updaters.states, tr_e._updaters.states
+    assert set(sf) == set(se)
+    for k in sf:
+        for a, b in zip(sf[k], se[k]):
+            np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_sgld_falls_back_to_eager():
+    c0 = profiler.get_counter("trainer_step_compile")
+    got, _ = _run_steps("sgld", {}, fused=True)
+    assert profiler.get_counter("trainer_step_compile") == c0
+    assert all(np.isfinite(g).all() for g in got)
+
+
+def test_one_executable_per_step_after_warmup():
+    params = _make_params([(8, 8), (8,)], seed=3)
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.01})
+    for p in params:
+        p.grad()[:] = mx.nd.array(
+            np.random.RandomState(5).randn(*p.shape).astype(np.float32))
+    trainer.step(2)   # warmup: the one compile
+    c0 = profiler.get_counter("trainer_step_compile")
+    h0 = profiler.get_counter("trainer_step_cache_hit")
+    for _ in range(5):
+        trainer.step(2)
+    assert profiler.get_counter("trainer_step_compile") == c0
+    assert profiler.get_counter("trainer_step_cache_hit") == h0 + 5
+
+
+def test_lr_schedule_change_does_not_recompile():
+    params = _make_params([(6, 4)], seed=4)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "clip_gradient": 1.0, "wd": 0.001})
+    params[0].grad()[:] = mx.nd.array(np.ones((6, 4), np.float32))
+    trainer.step(2)
+    c0 = profiler.get_counter("trainer_step_compile")
+    h0 = profiler.get_counter("trainer_step_cache_hit")
+    # every per-step dynamic hyper: lr, wd, clip value, rescale (batch)
+    for lr in (0.05, 0.01, 0.002):
+        trainer.set_learning_rate(lr)
+        trainer.step(2)
+    trainer._optimizer.wd = 0.01
+    trainer._optimizer.clip_gradient = 0.5
+    trainer.step(2)
+    trainer.step(batch_size=7)
+    assert profiler.get_counter("trainer_step_compile") == c0
+    assert profiler.get_counter("trainer_step_cache_hit") == h0 + 5
+    # structural changes DO recompile: clip presence flips the program
+    trainer._optimizer.clip_gradient = None
+    trainer.step(2)
+    assert profiler.get_counter("trainer_step_compile") == c0 + 1
+
+
+def test_shape_change_recompiles():
+    c0 = profiler.get_counter("trainer_step_compile")
+    _run_steps("sgd", {}, fused=True, steps=1, with_null=False,
+               shapes=((5, 5),))
+    _run_steps("sgd", {}, fused=True, steps=1, with_null=False,
+               shapes=((6, 5),))
+    assert profiler.get_counter("trainer_step_compile") == c0 + 2
+
+
+def test_trainer_save_load_states_roundtrip_with_fused(tmp_path):
+    _, trainer = _run_steps("adam", {}, fused=True)
+    fname = str(tmp_path / "opt.states")
+    trainer.save_states(fname)
+    _, trainer2 = _run_steps("adam", {}, fused=True, steps=1)
+    trainer2.load_states(fname)
+    def as_np(v):
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+    s1, s2 = trainer._updaters.states, trainer2._updaters.states
+    assert set(s1) == set(s2)
+    for k in s1:
+        for a, b in zip(s1[k], s2[k]):
+            np.testing.assert_allclose(as_np(a), as_np(b))
+    # training must continue after a load (states rewrapped as NDArray)
+    for p in trainer2._params:
+        if p.grad_req != "null":
+            p.grad()[:] = mx.nd.array(np.ones(p.shape, np.float32))
+    trainer2.step(batch_size=2)
+
+
+def test_custom_optimizer_uses_generic_fused_path():
+    @opt.register
+    class MyPlainSGD(opt.Optimizer):
+        def create_state(self, index, weight):
+            return None
+
+        def update(self, index, weight, grad, state):
+            lr = self._get_lr(index)
+            self._update_count(index)
+            weight -= lr * grad * self.rescale_grad
+
+    c0 = profiler.get_counter("trainer_step_compile")
+    got, _ = _run_steps("myplainsgd", {}, fused=True)
+    assert profiler.get_counter("trainer_step_compile") == c0 + 1
+    want, _ = _run_steps("myplainsgd", {}, fused=False)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-6)
+
+
+def test_stateful_custom_optimizer_falls_back_to_eager():
+    """A custom optimizer keeping per-step state on the instance (warmup
+    counter) cannot be replayed functionally — the fused layer must
+    detect the impure update() and pin it to the eager path instead of
+    silently training with a frozen value."""
+    @opt.register
+    class WarmupSGD(opt.Optimizer):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.t = 0
+
+        def create_state(self, index, weight):
+            return None
+
+        def update(self, index, weight, grad, state):
+            self.t += 1
+            lr = self._get_lr(index) * min(1.0, self.t / 3.0)
+            self._update_count(index)
+            weight -= lr * grad * self.rescale_grad
+
+    c0 = profiler.get_counter("trainer_step_compile")
+    f0 = profiler.get_counter("trainer_step_compile_failed")
+    got, _ = _run_steps("warmupsgd", {}, fused=True, steps=5)
+    # must NOT have produced a cached fused program, and must pay the
+    # failed trace exactly ONCE (instance pinned to eager afterwards —
+    # the evolving warmup counter lands in the sig, so a per-sig
+    # negative cache alone would re-trace every step)
+    assert profiler.get_counter("trainer_step_compile") == c0
+    assert profiler.get_counter("trainer_step_compile_failed") == f0 + 1
+    want, _ = _run_steps("warmupsgd", {}, fused=False, steps=5)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-7)
+
+
+def test_module_update_uses_fused_step():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = mx.io.DataBatch(data=[mx.nd.array(np.random.rand(4, 6))],
+                            label=[mx.nd.array(np.zeros(4))])
+    c0 = profiler.get_counter("trainer_step_compile")
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert profiler.get_counter("trainer_step_compile") == c0 + 1
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all()
+
+
+# ------------------------------------------------------- shared cache fixes
+
+
+def test_function_closure_no_collision():
+    """advisor HIGH: two same-shaped closure-backed Functions must not
+    replay each other's compiled backward (Scale(2.0)/Scale(3.0))."""
+
+    class Scale(autograd.Function):
+        def __init__(self, s):
+            self.s = s
+
+        def forward(self, x):
+            return x * self.s
+
+        def backward(self, dy):
+            return dy * self.s
+
+    x = mx.nd.array([1.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = Scale(2.0)(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+    with autograd.record():
+        y = Scale(3.0)(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_compile_cache_bounded_retry():
+    """advisor low: a transient first failure must not pin a structure to
+    eager forever; structural untraceability must."""
+    c = _fused.CompileCache("unit_retry")
+    sig = ("some", "structure")
+    assert not c.should_skip(sig)
+    c.mark_failed(sig)                      # transient #1
+    assert not c.should_skip(sig)           # retried
+    c.mark_failed(sig)                      # transient #2
+    assert not c.should_skip(sig)
+    c.mark_failed(sig)                      # transient #3 -> give up
+    assert c.should_skip(sig)
+    # success on another sig clears its failure history
+    sig2 = ("other",)
+    c.mark_failed(sig2)
+    c.put(sig2, lambda: None)
+    assert not c.should_skip(sig2)
+    # structural failures skip immediately
+    sig3 = ("structural",)
+    c.mark_failed(sig3, permanent=True)
+    assert c.should_skip(sig3)
+
+
+def test_structural_failure_classification():
+    import jax
+    assert _fused.structural_failure(_fused.Uncacheable("x"))
+    assert _fused.structural_failure(
+        jax.errors.TracerBoolConversionError.__new__(
+            jax.errors.TracerBoolConversionError))
+    assert not _fused.structural_failure(RuntimeError("RESOURCE_EXHAUSTED"))
+
+
+def test_fn_token_stable_and_distinct():
+    f = lambda x: x          # noqa: E731
+    g = lambda x: x          # noqa: E731
+    assert _fused.fn_token(f) == _fused.fn_token(f)
+    assert _fused.fn_token(f) != _fused.fn_token(g)
+
+
+# ------------------------------------------------------- layernorm knob
+
+
+def test_layernorm_two_pass_flag():
+    rng = np.random.RandomState(0)
+    # large common offset: one-pass E[x^2]-E[x]^2 cancels catastrophically
+    # in f32, the two-pass form stays accurate
+    x = (1e4 + rng.randn(8, 256)).astype(np.float32)
+    gamma = np.ones(256, np.float32)
+    beta = np.zeros(256, np.float32)
+
+    x64 = x.astype(np.float64)
+    mean = x64.mean(axis=-1, keepdims=True)
+    var = ((x64 - mean) ** 2).mean(axis=-1, keepdims=True)
+    ref = ((x64 - mean) / np.sqrt(var + 1e-5)).astype(np.float64)
+
+    def run():
+        out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta))
+        return out.asnumpy().astype(np.float64)
+
+    err_one_pass = np.abs(run() - ref).max()
+    cfg.set("MXNET_TPU_LAYERNORM_TWO_PASS", True)
+    try:
+        err_two_pass = np.abs(run() - ref).max()
+    finally:
+        cfg.reset("MXNET_TPU_LAYERNORM_TWO_PASS")
+    assert err_two_pass < 0.01, err_two_pass
+    assert err_two_pass < err_one_pass

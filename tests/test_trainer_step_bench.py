@@ -1,0 +1,32 @@
+"""Tier-1 smoke for tools/perf/trainer_step_bench.py (not slow).
+
+Runs the quick variant end-to-end (real forward/backward + timed step
+loops on the doc-evidence MLP) and asserts the mechanics the acceptance
+criteria care about: the fused path engages, produces finite throughput,
+and dispatches one executable per step. Wall-clock speedup is recorded by
+the full bench (BENCH_trainer_step.json), not asserted here — shared CI
+hosts are too noisy for a hard ratio gate.
+"""
+import importlib
+import os
+import sys
+
+import numpy as np
+
+
+def test_trainer_step_bench_quick():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "perf"))
+    try:
+        bench = importlib.import_module("trainer_step_bench")
+    finally:
+        sys.path.pop(0)
+    results = bench.run(quick=True)
+    assert "mlp_sgd" in results and "mlp_adam" in results
+    for key, r in results.items():
+        assert r["n_params"] >= 4
+        assert np.isfinite(r["eager_steps_per_s"]) and \
+            r["eager_steps_per_s"] > 0
+        assert np.isfinite(r["fused_steps_per_s"]) and \
+            r["fused_steps_per_s"] > 0

@@ -1,0 +1,129 @@
+"""Trainer.step: eager per-param dispatch vs the fused whole-model update.
+
+Measures optimizer-step throughput (steps/s) on two models:
+
+* the doc-evidence MLP (Dense 128 relu -> Dense 10; 4 params) — the same
+  network tools/perf/doc_evidence.py uses for the fused-fit numbers;
+* a small ResNet stem (7x7/2 conv + BatchNorm + Dense head; conv/BN/FC
+  param mix, 8 params).
+
+Gradients are produced once with a real forward/backward; the timed loop
+then re-applies ``trainer.step`` so the number isolates the update path:
+eager = one engine dispatch chain per parameter (the reference KVStore
+push/pull + per-index Updater regime), fused = ONE structure-cached jitted
+program per step (MXNET_TPU_FUSED_TRAINER, mxnet_tpu/_fused.py).
+
+Usage: python tools/perf/trainer_step_bench.py [--quick] [--json PATH]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+
+def _build_mlp():
+    from mxnet_tpu.gluon import nn
+    net = nn.Sequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+    return net, (32, 64)
+
+
+def _build_resnet_stem():
+    from mxnet_tpu.gluon import nn
+    net = nn.Sequential()
+    net.add(nn.Conv2D(16, kernel_size=7, strides=2, padding=3),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.MaxPool2D(pool_size=3, strides=2, padding=1),
+            nn.Flatten(),
+            nn.Dense(10))
+    return net, (8, 3, 32, 32)
+
+
+def _bench_one(build, optimizer, fused, n_steps, opt_kwargs=None):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import config as cfg
+
+    cfg.set("MXNET_TPU_FUSED_TRAINER", fused)
+    try:
+        net, in_shape = build()
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), optimizer,
+                                dict(opt_kwargs or {}, learning_rate=0.05))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.rand(*in_shape).astype(np.float32))
+        y = mx.nd.array(rng.randint(0, 10, (in_shape[0],)))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        n_params = sum(1 for p in net.collect_params().values()
+                       if p.grad_req != "null")
+        for _ in range(3):
+            trainer.step(in_shape[0])   # warmup (compile + steady state)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            trainer.step(in_shape[0])
+        # sync: include all queued device work in the measurement
+        next(iter(net.collect_params().values())).data().asnumpy()
+        dt = time.perf_counter() - t0
+        return n_steps / dt, n_params
+    finally:
+        cfg.reset("MXNET_TPU_FUSED_TRAINER")
+
+
+def run(quick=False, reps=1):
+    n = 50 if quick else 400
+    results = {}
+    models = [("mlp", _build_mlp)]
+    if not quick:
+        models.append(("resnet_stem", _build_resnet_stem))
+    for model_name, build in models:
+        for opt_name, kw in [("sgd", {"momentum": 0.9}), ("adam", {})]:
+            # best-of-reps: shared/loaded hosts make single runs noisy
+            eager = fused = 0.0
+            n_params = 0
+            for _ in range(reps):
+                e, n_params = _bench_one(build, opt_name, False, n, kw)
+                f, _ = _bench_one(build, opt_name, True, n, kw)
+                eager, fused = max(eager, e), max(fused, f)
+            key = "%s_%s" % (model_name, opt_name)
+            results[key] = {
+                "n_params": n_params,
+                "eager_steps_per_s": round(eager, 1),
+                "fused_steps_per_s": round(fused, 1),
+                "speedup": round(fused / eager, 2),
+            }
+            print("%-22s %2d params  eager %8.1f steps/s   fused %8.1f "
+                  "steps/s   %5.2fx" % (key, n_params, eager, fused,
+                                        fused / eager))
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="fast smoke variant (fewer steps, MLP only)")
+    ap.add_argument("--reps", type=int, default=1,
+                    help="repetitions; best throughput per config is kept")
+    ap.add_argument("--json", default=None, help="write results to PATH")
+    args = ap.parse_args()
+    results = run(quick=args.quick, reps=args.reps)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"bench": "trainer_step", "results": results}, f,
+                      indent=2)
+        print("wrote", args.json)
+    return results
+
+
+if __name__ == "__main__":
+    main()
