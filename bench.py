@@ -14,12 +14,24 @@ the bench also reports a transformer LM (models/transformer.py) through
 the identical Module fused-step path — the workload class whose large
 matmuls can actually feed the MXU.
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N/181.53,
-   "mfu": ..., "batch": ..., "flops_per_img": ..., "peak_flops": ...,
-   "transformer_tok_s": ..., "transformer_mfu": ...}
+Wedge-proofing (round-5 top item): each workload runs as its own
+*section* in a child process with its own timeout, and every section's
+JSON record is printed (and flushed) the moment it completes — so a
+tunnel-wedge hang or an external kill loses ONE section, not the whole
+artifact (round 5: rc 124 left BENCH_r05.json empty). Output protocol:
+
+  {"section": "resnet", ...}        <- line per section, as it finishes
+  {"section": "transformer", ...}
+  {"metric": ..., "value": ...}     <- LAST line: merged record, the
+                                       schema previous rounds consumed
+
+Consumers that take the last line keep working; consumers that want
+partial results on a wedge read the section lines.
+Per-section timeout: $BENCH_SECTION_TIMEOUT_SECS (default 600).
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -33,6 +45,7 @@ BASELINE_IMG_S = 181.53   # P100 training, ResNet-50 batch 32
 BATCH = 256
 WARMUP = 3
 ITERS = 20
+SECTIONS = ("resnet", "transformer")
 
 # Analytic model FLOPs: ResNet-50 @224x224 forward = 4.089e9 multiply-adds
 # (= 8.18 GFLOP at 2 FLOPs/MAC); training step ~ 3x forward (fwd + 2x in bwd).
@@ -52,9 +65,17 @@ def _peak_flops(device_kind: str):
     return None  # unknown device: report img/s only, no fabricated MFU
 
 
-def bench_transformer(mx, np, jax, peak):
+def section_transformer():
     """Transformer-LM fused train step: tokens/s + MFU on one chip."""
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
     from mxnet_tpu.models import transformer
+
+    if not mx.num_devices("tpu"):
+        return {"skipped": "no tpu attached"}
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    mx.amp.init("bfloat16")
     # ~0.67B-param GPT-2-medium-class decoder LM with the Pallas flash
     # attention kernel (fused fwd + dQ/dK/dV backward). Measured sweep on
     # this chip (see docs/perf.md): flash beats dense batch_dot attention
@@ -98,10 +119,10 @@ def bench_transformer(mx, np, jax, peak):
     n_embed = V * D + T * D
     flops_per_tok = 6 * (n_params - n_embed) + 12 * L * D * T
     mfu = round(tok_s * flops_per_tok / peak, 4) if peak else None
-    return round(tok_s, 1), mfu
+    return {"transformer_tok_s": round(tok_s, 1), "transformer_mfu": mfu}
 
 
-def main():
+def section_resnet():
     import numpy as np
     import jax
     import mxnet_tpu as mx
@@ -159,11 +180,7 @@ def main():
     img_s = batch * iters / dt
     peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else None
     mfu = round(img_s * TRAIN_FLOPS_PER_IMG / peak, 4) if peak else None
-    if on_tpu:
-        tok_s, tmfu = bench_transformer(mx, np, jax, peak)
-    else:
-        tok_s, tmfu = None, None
-    print(json.dumps({
+    return {
         "metric": "resnet50_train_bf16",
         "value": round(img_s, 2),
         "unit": "img/s",
@@ -172,9 +189,71 @@ def main():
         "batch": batch,
         "flops_per_img": TRAIN_FLOPS_PER_IMG,
         "peak_flops": peak,
-        "transformer_tok_s": tok_s,
-        "transformer_mfu": tmfu,
-    }))
+    }
+
+
+def run_section(name):
+    fn = {"resnet": section_resnet, "transformer": section_transformer}[name]
+    rec = dict(fn())
+    rec["section"] = name
+    print(json.dumps(rec), flush=True)
+
+
+def _merge(records):
+    """Assemble the flat single-record schema previous rounds consumed
+    from whatever sections survived."""
+    merged = {
+        "metric": "resnet50_train_bf16", "value": None, "unit": "img/s",
+        "vs_baseline": None, "mfu": None, "batch": None,
+        "flops_per_img": TRAIN_FLOPS_PER_IMG, "peak_flops": None,
+        "transformer_tok_s": None, "transformer_mfu": None,
+    }
+    errors = {}
+    for name, rec in records.items():
+        if "error" in rec:
+            errors[name] = rec["error"]
+            continue
+        for k in merged:
+            if k in rec:
+                merged[k] = rec[k]
+    if errors:
+        merged["errors"] = errors
+    return merged
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        run_section(sys.argv[2])
+        return
+    timeout = float(os.environ.get("BENCH_SECTION_TIMEOUT_SECS", "600"))
+    records = {}
+    for name in SECTIONS:
+        _note("bench: section %s (timeout %ds)" % (name, timeout))
+        rec = {"section": name}
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--section", name],
+                timeout=timeout, stdout=subprocess.PIPE, text=True)
+            lines = [l for l in (proc.stdout or "").splitlines()
+                     if l.strip()]
+            if proc.returncode != 0:
+                rec["error"] = "rc %d" % proc.returncode
+            elif not lines:
+                rec["error"] = "no output"
+            else:
+                rec = json.loads(lines[-1])
+        except subprocess.TimeoutExpired:
+            # the wedge case: this section hung; its sibling sections
+            # still run and still report
+            rec["error"] = "timeout after %ds" % timeout
+        except Exception as exc:                           # noqa: BLE001
+            rec["error"] = "%s: %s" % (type(exc).__name__, exc)
+        records[name] = rec
+        # incremental line-per-section: flushed NOW, so a later wedge
+        # cannot take this section's result with it
+        print(json.dumps(rec), flush=True)
+    print(json.dumps(_merge(records)), flush=True)
 
 
 if __name__ == "__main__":

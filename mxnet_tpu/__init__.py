@@ -55,6 +55,7 @@ from . import module
 from . import module as mod
 from . import predictor
 from .predictor import Predictor
+from . import serve
 from . import gluon
 from . import models
 from . import rnn

@@ -97,6 +97,20 @@ register("MXNET_TPU_FUSED_TRAINER", _parse_bool, True,
          "gluon Trainer.step / Module.update: batch all parameter updates "
          "into one structure-cached, donated jitted program; 0 = eager "
          "per-param dispatch")
+register("MXNET_TPU_SERVE", _parse_bool, True,
+         "serve.InferenceServer: coalesce concurrent requests into "
+         "bucket-padded micro-batches served by a finite executable set; "
+         "0 = per-request eager forward in the caller thread (no "
+         "batching, no bucketing — the debugging/bisection fallback)")
+register("MXNET_TPU_SERVE_MAX_BATCH", int, 32,
+         "serve: default micro-batch row bound (requests coalesced per "
+         "dispatch; the largest batch bucket)")
+register("MXNET_TPU_SERVE_MAX_DELAY_US", int, 2000,
+         "serve: default batching window — how long the oldest queued "
+         "request may wait for co-riders before the batch launches")
+register("MXNET_TPU_SERVE_QUEUE_BOUND", int, 1024,
+         "serve: default admission bound; submit() load-sheds (QueueFull) "
+         "when this many requests are already queued")
 register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
          "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
          "one-pass E[x^2]-E[x]^2 form — restores precision for "

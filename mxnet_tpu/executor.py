@@ -175,6 +175,13 @@ class Executor:
         self._group2ctx = group2ctx
         self._shared_exec = shared_exec
         self._fn = graph_function(symbol, self._node_device_fn())
+        # programs embedding host-callback custom ops must run
+        # synchronously with the frontend: async execution + concurrent
+        # eager dispatch deadlocks the CPU runtime (the train_rcnn eval
+        # hang — see operator.prop_uses_host_callback)
+        from . import operator as _operator
+        self._sync_host_callbacks = \
+            _operator.symbol_has_host_callback(symbol)
         self._base_key = _random.next_key()
         self._step = 0
         self._outputs: Optional[List[_nd.NDArray]] = None
@@ -296,6 +303,8 @@ class Executor:
         else:
             outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
                                           bool(is_train))
+            if self._sync_host_callbacks:
+                jax.block_until_ready(outs)
             self._commit(outs, new_aux)
             self._pending = None
         return self.outputs
@@ -328,6 +337,8 @@ class Executor:
         else:
             outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
                                                      key, heads)
+        if self._sync_host_callbacks:
+            jax.block_until_ready((outs, grads))
         self._commit(outs, new_aux)
         self._pending = None
         for n, g in grads.items():
@@ -362,6 +373,8 @@ class Executor:
         if self._outputs is None and self._pending is not None:
             arg_vals, aux_vals, key = self._pending
             outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key, True)
+            if self._sync_host_callbacks:
+                jax.block_until_ready(outs)
             self._commit(outs, new_aux)
         if self._outputs is None:
             raise MXNetError("no forward has been run")

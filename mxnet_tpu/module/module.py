@@ -555,6 +555,11 @@ class Module(BaseModule):
             outs, new_params, new_states, new_aux = self._fused_jit(
                 params, states, aux, inputs, frozen_vals, key,
                 jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.int32))
+            if ex._sync_host_callbacks:
+                # callback-bearing program: execute synchronously with
+                # the frontend (see executor.py / operator.py — the
+                # async-drain deadlock)
+                jax.block_until_ready(outs)
             for n in param_names:
                 ex.arg_dict[n]._data = new_params[n]
                 ex.arg_dict[n]._version += 1

@@ -31,6 +31,8 @@ src/operator/custom/custom.cc:380-405 kLocal semantics).
 """
 from __future__ import annotations
 
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -39,6 +41,39 @@ __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class",
            "PythonOp", "NumpyOp", "NDArrayOp"]
 
 _PROP_REGISTRY: Dict[str, type] = {}
+
+# --------------------------------------------------- host-callback thread
+# The user's forward/backward runs eager NDArray code, i.e. it re-enters
+# jax dispatch. Executing it directly on the runtime's host-callback
+# thread can deadlock: that thread is part of the machinery draining the
+# async dispatch queue, so an eval-time custom op issued while queued
+# train steps drain waits on a queue that can only drain through the
+# thread it is blocking (the train_rcnn eval hang). All callback-path
+# custom-op Python therefore runs on ONE dedicated worker thread — the
+# callback thread only blocks on the future, and the worker's eager
+# dispatches proceed like any ordinary frontend thread's. (One thread,
+# not a pool: the reference serializes custom ops through its own
+# CustomOperator worker the same way, custom-inl.h Push.)
+
+_cb_lock = threading.Lock()
+_cb_executor: Optional[ThreadPoolExecutor] = None
+_cb_thread_ident: Optional[int] = None
+
+
+def _run_on_custom_op_thread(fn, *args):
+    global _cb_executor
+    if threading.get_ident() == _cb_thread_ident:
+        return fn(*args)      # nested custom op: run inline, don't self-wait
+    if _cb_executor is None:
+        with _cb_lock:
+            if _cb_executor is None:
+                def _note_ident():
+                    global _cb_thread_ident
+                    _cb_thread_ident = threading.get_ident()
+                _cb_executor = ThreadPoolExecutor(
+                    1, thread_name_prefix="mxnet_tpu.custom_op",
+                    initializer=_note_ident)
+    return _cb_executor.submit(fn, *args).result()
 
 
 class CustomOp(object):
@@ -144,6 +179,34 @@ def register(reg_name: str):
         return prop_cls
 
     return _reg
+
+
+def prop_uses_host_callback(op_type: str) -> bool:
+    """True when this op_type's custom op runs user Python through the
+    host-callback path (no ``forward_traced`` override). Programs
+    embedding such ops must be executed SYNCHRONOUSLY with the frontend
+    (executor.py): the callback's user code re-enters eager jax
+    dispatch, and if the frontend thread dispatches concurrently while
+    the program is in flight the CPU runtime can deadlock — observed as
+    the train_rcnn eval hang (frontend blocked in apply_primitive, the
+    runtime waiting on the callback, the callback's dispatches waiting
+    on the frontend's lock)."""
+    cls = _PROP_REGISTRY.get(op_type)
+    if cls is None:
+        return True        # unknown yet: be conservative
+    return cls.forward_traced is CustomOpProp.forward_traced
+
+
+def symbol_has_host_callback(symbol) -> bool:
+    """Scan a Symbol graph for callback-path Custom ops (see
+    :func:`prop_uses_host_callback`)."""
+    from .symbol.symbol import _topo_order
+    for node in _topo_order(symbol._entries):
+        if node.op is not None and node.op.name == "Custom":
+            op_type = node.attrs.get("op_type")
+            if op_type is None or prop_uses_host_callback(str(op_type)):
+                return True
+    return False
 
 
 def get_prop_class(op_type: str) -> type:
@@ -260,7 +323,7 @@ def _custom_impl(arrays, op_type, attrs, is_train):
                                    itypes)
     n_in = len(arrays)
 
-    def host_forward(*xs):
+    def _forward_impl(*xs):
         in_data = [nd.array(np.asarray(x)) for x in xs]
         out_data = [nd.NDArray(np.zeros(s, t))
                     for s, t in zip(oshapes, otypes)]
@@ -269,7 +332,7 @@ def _custom_impl(arrays, op_type, attrs, is_train):
         return tuple(o.asnumpy().astype(t, copy=False)
                      for o, t in zip(out_data, otypes))
 
-    def host_backward(xs, outs, cts):
+    def _backward_impl(xs, outs, cts):
         in_data = [nd.array(np.asarray(x)) for x in xs]
         out_data = [nd.array(np.asarray(o)) for o in outs]
         out_grad = [nd.array(np.asarray(c)) for c in cts] \
@@ -281,6 +344,14 @@ def _custom_impl(arrays, op_type, attrs, is_train):
                          in_grad=in_grad, aux=[])
         return tuple(g.asnumpy().astype(a.dtype, copy=False)
                      for g, a in zip(in_grad, xs))
+
+    # the runtime's callback thread must never run user NDArray code
+    # itself (deadlock — see _run_on_custom_op_thread)
+    def host_forward(*xs):
+        return _run_on_custom_op_thread(_forward_impl, *xs)
+
+    def host_backward(xs, outs, cts):
+        return _run_on_custom_op_thread(_backward_impl, xs, outs, cts)
 
     @jax.custom_vjp
     def run(*xs):
@@ -296,6 +367,12 @@ def _custom_impl(arrays, op_type, attrs, is_train):
 
     run.defvjp(run_fwd, run_bwd)
     outs = run(*arrays)
+    # serialize with the frontend: an async in-flight callback program +
+    # concurrent eager dispatch is the deadlock recipe above. Eager
+    # custom-op call sites pay a sync — the documented cost model for
+    # the callback path (host round-trip per call) already says "glue,
+    # not hot loops".
+    jax.block_until_ready(outs)
     return outs if len(outs) != 1 else outs[0]
 
 

@@ -10,6 +10,11 @@ frozen weights — ``Predictor`` binds a forward-only Executor (no gradient
 graph), device-puts the params once, and every ``forward`` is a single
 cached-compile call. ``reshape`` rebinds for a new input geometry the way
 ``MXPredReshape`` does.
+
+This is the single-request surface. For concurrent traffic, wrap it in
+``mx.serve.InferenceServer`` (docs/architecture/serving.md): requests
+coalesce into bucket-padded micro-batches and a finite executable set
+serves arbitrary load with zero steady-state recompiles.
 """
 from __future__ import annotations
 

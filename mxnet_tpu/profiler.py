@@ -28,6 +28,7 @@ __all__ = [
     "set_config", "set_state", "dump", "pause", "resume",
     "start_xla_trace", "stop_xla_trace", "record_event", "state",
     "incr_counter", "get_counter", "counters", "reset_counters",
+    "set_gauge", "get_gauge", "gauges", "reset_gauges",
 ]
 
 _lock = threading.Lock()
@@ -106,6 +107,36 @@ def counters() -> dict:
 def reset_counters() -> None:
     with _lock:
         _counters.clear()
+
+
+# -------------------------------------------------------------- gauges
+# Point-in-time values (queue depth, batch occupancy, ...) — unlike the
+# monotonic counters above these are set, not accumulated. They share the
+# counter registry's cheap always-on discipline so serving dashboards and
+# tests can read them without enabling tracing.
+
+_gauges: dict = {}
+
+
+def set_gauge(name: str, value: float) -> None:
+    with _lock:
+        _gauges[name] = value
+
+
+def get_gauge(name: str, default: float = 0.0) -> float:
+    with _lock:
+        return _gauges.get(name, default)
+
+
+def gauges() -> dict:
+    """Snapshot of all gauges."""
+    with _lock:
+        return dict(_gauges)
+
+
+def reset_gauges() -> None:
+    with _lock:
+        _gauges.clear()
 
 
 class record(object):

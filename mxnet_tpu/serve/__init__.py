@@ -1,0 +1,27 @@
+"""``mx.serve`` — dynamic-batching inference serving.
+
+The deployment layer above :class:`~mxnet_tpu.predictor.Predictor`:
+concurrent requests are coalesced into bucket-padded micro-batches so a
+finite set of jitted executables serves arbitrary traffic with zero
+steady-state recompiles. See :mod:`.server` for the design and
+``docs/architecture/serving.md`` for the full matrix.
+
+    server = mx.serve.InferenceServer(net, max_batch_size=32)
+    futures = [server.submit(x) for x in requests]
+    results = [f.result() for f in futures]
+    server.stats()   # p50/p95/p99, occupancy, per-bucket compiles
+    server.close()   # graceful drain
+
+Kill switch: ``MXNET_TPU_SERVE=0`` degrades every ``submit`` to an
+eager per-request forward in the caller thread (the bisection fallback,
+mirroring ``MXNET_TPU_FUSED_TRAINER``).
+"""
+from .bucketing import BucketSpec
+from .server import (DeadlineExceeded, InferenceServer, QueueFull,
+                     ServeError, ServerClosed, wrap_model)
+from .stats import LatencyStats
+
+__all__ = [
+    "InferenceServer", "BucketSpec", "LatencyStats", "wrap_model",
+    "ServeError", "ServerClosed", "QueueFull", "DeadlineExceeded",
+]

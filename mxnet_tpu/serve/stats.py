@@ -1,0 +1,66 @@
+"""Serving observability: latency distribution + throughput accounting.
+
+Latencies land in a bounded ring (recent-window reservoir, the same
+bounded-memory discipline as CompileCache) so a long-lived server's
+``stats()`` reflects current behavior, not its lifetime average, and
+memory stays O(capacity) at any request volume. Percentiles are computed
+on snapshot, not on record — the submit path stays O(1) under the lock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["LatencyStats"]
+
+
+class LatencyStats:
+    """Thread-safe bounded reservoir of per-request latencies (seconds)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._ring = np.zeros(self.capacity, np.float64)
+        self._n = 0            # total recorded (monotonic)
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._ring[self._n % self.capacity] = seconds
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def reset(self) -> None:
+        """Drop the retained window (e.g. after warmup, so compile-time
+        latencies don't pollute steady-state percentiles)."""
+        with self._lock:
+            self._n = 0
+
+    def snapshot(self) -> Optional[Dict[str, float]]:
+        """{p50, p95, p99, mean, max, window} in milliseconds over the
+        retained window; None before the first request."""
+        with self._lock:
+            n = min(self._n, self.capacity)
+            if n == 0:
+                return None
+            window = self._ring[:n].copy()
+        p50, p95, p99 = np.percentile(window, [50.0, 95.0, 99.0])
+        return {
+            "p50_ms": round(float(p50) * 1e3, 4),
+            "p95_ms": round(float(p95) * 1e3, 4),
+            "p99_ms": round(float(p99) * 1e3, 4),
+            "mean_ms": round(float(window.mean()) * 1e3, 4),
+            "max_ms": round(float(window.max()) * 1e3, 4),
+            "window": int(n),
+        }
+
+
+def monotonic() -> float:
+    """The one clock every serve timestamp uses (monotonic: deadlines
+    must survive wall-clock steps)."""
+    return time.monotonic()

@@ -6,7 +6,7 @@ Reference: the Storage layer (``include/mxnet/storage.h:35-93``,
 allocator (the pooling job of GPUPooledStorageManager), so this layer
 exposes what remains meaningful:
 
-* **memory spaces** — every device advertises ``device`` (HBM),
+* **memory spaces** — accelerator devices advertise ``device`` (HBM),
   ``pinned_host`` and ``unpinned_host`` kinds; ``as_in_memory`` moves an
   NDArray between them. Pinned host memory is the TPU twin of the
   reference's PinnedMemoryStorage: staged there, device transfers are
@@ -18,6 +18,15 @@ exposes what remains meaningful:
 * **allocation stats** — ``memory_stats`` surfaces the PJRT allocator
   counters (bytes_in_use, peak_bytes_in_use, ...) that the reference's
   storage managers tracked internally.
+
+Capability note: the memory-kinds surface drifts across jax/PJRT
+versions and backends — this build's CPU backend advertises only
+``unpinned_host`` (which doubles as its default/"device" space).
+Everything here degrades gracefully: ``supports_memory_kind`` is the
+capability probe, ``memory_kind_of`` reports ``DEVICE`` for whatever the
+device's *default* space is called, and ``as_in_memory`` falls back to
+the nearest advertised space instead of raising on backends without a
+distinct pinned pool.
 """
 from __future__ import annotations
 
@@ -26,10 +35,12 @@ from typing import Dict, List, Optional
 from .context import Context, current_context
 
 __all__ = ["memory_kinds", "memory_stats", "as_in_memory", "memory_kind_of",
-           "offload", "restore", "PINNED_HOST", "DEVICE"]
+           "supports_memory_kind", "default_memory_kind",
+           "offload", "restore", "PINNED_HOST", "UNPINNED_HOST", "DEVICE"]
 
 DEVICE = "device"
 PINNED_HOST = "pinned_host"
+UNPINNED_HOST = "unpinned_host"
 
 
 def _device(ctx: Optional[Context]):
@@ -37,8 +48,35 @@ def _device(ctx: Optional[Context]):
 
 
 def memory_kinds(ctx: Optional[Context] = None) -> List[str]:
-    """Memory spaces addressable by ``ctx``'s device."""
-    return [m.kind for m in _device(ctx).addressable_memories()]
+    """Memory spaces addressable by ``ctx``'s device (empty when the
+    runtime predates the memories API)."""
+    dev = _device(ctx)
+    try:
+        return [m.kind for m in dev.addressable_memories()]
+    except (AttributeError, NotImplementedError):
+        return []
+
+
+def default_memory_kind(ctx: Optional[Context] = None) -> str:
+    """The kind of the device's default memory space — what ``DEVICE``
+    means on this backend (``device`` on TPU HBM, ``unpinned_host`` on
+    this build's CPU backend)."""
+    dev = _device(ctx)
+    try:
+        return dev.default_memory().kind
+    except (AttributeError, NotImplementedError):
+        return DEVICE
+
+
+def supports_memory_kind(kind: str, ctx: Optional[Context] = None) -> bool:
+    """Capability probe: can arrays be placed in ``kind`` on this
+    device? ``DEVICE`` additionally matches the default space whatever
+    its advertised name — and is always available, even on runtimes
+    predating the memories API (where ``memory_kind_of``/
+    ``as_in_memory`` likewise fall back to the default space)."""
+    if kind == DEVICE:
+        return True
+    return kind in memory_kinds(ctx)
 
 
 def memory_stats(ctx: Optional[Context] = None) -> Dict[str, int]:
@@ -48,20 +86,54 @@ def memory_stats(ctx: Optional[Context] = None) -> Dict[str, int]:
 
 
 def memory_kind_of(arr) -> str:
-    """The memory space an NDArray currently lives in."""
+    """The memory space an NDArray currently lives in. The device's
+    default space reports as ``DEVICE`` regardless of its
+    backend-specific name, so "is this on-device?" checks are portable."""
     data = arr.data if hasattr(arr, "data") else arr
     kind = getattr(data.sharding, "memory_kind", None)
-    return kind or DEVICE
+    if kind is None:
+        return DEVICE
+    try:
+        if kind == data.sharding._device_assignment[0].default_memory().kind:
+            return DEVICE
+    except (AttributeError, IndexError, NotImplementedError):
+        pass
+    return kind
+
+
+def _resolve_kind(kind: str, ctx: Optional[Context]) -> Optional[str]:
+    """Map a requested kind onto what this device actually advertises
+    (graceful fallback), or None for a plain default-space placement."""
+    kinds = memory_kinds(ctx)
+    if not kinds:
+        return None                  # memories API absent: default space
+    if kind in kinds:
+        return kind
+    if kind == DEVICE:
+        return None                  # default space IS the device space
+    if kind == PINNED_HOST and UNPINNED_HOST in kinds:
+        # no pinned pool on this backend (CPU): stage to plain host
+        # memory — offload still works, transfers just aren't DMA-pinned
+        return UNPINNED_HOST
+    raise ValueError(
+        "memory kind %r not addressable by this device (advertised: %s)"
+        % (kind, kinds))
 
 
 def as_in_memory(arr, kind: str, ctx: Optional[Context] = None):
     """Copy an NDArray into the given memory space of ``ctx``'s device
-    (reference parity: Storage::Alloc with a pinned/device context)."""
+    (reference parity: Storage::Alloc with a pinned/device context).
+    Falls back to the nearest advertised space on backends without the
+    requested one — probe with :func:`supports_memory_kind` when exact
+    placement matters."""
     import jax
     from jax.sharding import SingleDeviceSharding
     from . import ndarray as nd
     data = arr.data if hasattr(arr, "data") else arr
-    sharding = SingleDeviceSharding(_device(ctx), memory_kind=kind)
+    resolved = _resolve_kind(kind, ctx)
+    if resolved is None:
+        return nd.NDArray(jax.device_put(data, _device(ctx)))
+    sharding = SingleDeviceSharding(_device(ctx), memory_kind=resolved)
     return nd.NDArray(jax.device_put(data, sharding))
 
 

@@ -48,6 +48,33 @@ jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
 import numpy as _np  # noqa: E402
 import pytest  # noqa: E402
 
+# Test modules that run multi-device programs (shard_map/collectives over
+# the virtual 8-device mesh). On this jax/XLA version a collective-bearing
+# CPU executable loaded from the persistent compile cache intermittently
+# computes WRONG results (reproduced: test_1f1b_matches_gpipe_one_step
+# diffs of ~2.0 with a warm cache, 0 failures in 10+ runs with a cold
+# cache, both schedules individually deterministic) — so multi-device
+# tests compile fresh and only single-device programs use the cache.
+_MULTIDEVICE_TEST_MODULES = {
+    "test_kvstore_parallel", "test_model_parallel", "test_moe",
+    "test_pipeline_module", "test_pipeline_parallel",
+    "test_tensor_parallel", "test_transformer", "test_dist",
+}
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache_for_multidevice(request):
+    mod = getattr(request.node, "module", None)
+    name = getattr(mod, "__name__", "") or ""
+    if name.rsplit(".", 1)[-1] in _MULTIDEVICE_TEST_MODULES:
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    else:
+        yield
+
 
 @pytest.fixture(autouse=True)
 def _seed_rngs():

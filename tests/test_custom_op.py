@@ -303,3 +303,62 @@ def test_traced_custom_loss_int_labels():
     pn = np.asarray(p.asnumpy())
     oh = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
     np.testing.assert_allclose(g, pn - oh, rtol=1e-5, atol=1e-6)
+
+
+_EVAL_DRAIN_SCRIPT = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, %(root)r)
+import numpy as np
+import mxnet_tpu as mx
+
+
+@mx.operator.register("evaltime_identity")
+class IdProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        class Id(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                # eager NDArray dispatch INSIDE the host callback — the
+                # re-entrancy that wedged train_rcnn's eval
+                self.assign(out_data[0], req[0], in_data[0] * 1.0)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0], out_grad[0])
+        return Id()
+
+
+import jax
+import jax.numpy as jnp
+
+# fill the async dispatch queue with heavy jitted steps, then run the
+# callback-path custom op while they drain (the rcnn eval pattern:
+# queued train steps + an eval-time proposal op)
+f = jax.jit(lambda x: (x @ x.T).sum())
+h = jnp.ones((512, 512))
+pending = [f(h) for _ in range(64)]
+out = mx.nd.Custom(mx.nd.array(np.ones((4, 5), np.float32)),
+                   op_type="evaltime_identity")
+assert float(out.asnumpy().sum()) == 20.0
+jax.block_until_ready(pending)
+print("DRAIN_OK")
+"""
+
+
+def test_callback_custom_op_while_async_queue_drains():
+    """Regression (train_rcnn eval deadlock): a callback-path custom op
+    issued while async-queued jitted work drains must complete — its
+    user Python runs on the dedicated custom-op thread, never on the
+    runtime callback thread. Hard subprocess timeout turns a regression
+    into a fast failure instead of a suite wedge."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _EVAL_DRAIN_SCRIPT % {"root": root}
+    proc = subprocess.run(
+        [sys.executable, "-c", script], timeout=120,
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DRAIN_OK" in proc.stdout

@@ -1,0 +1,53 @@
+"""Tier-1 smoke for tools/perf/serve_bench.py (not slow).
+
+Runs the quick variant end-to-end (real closed-loop clients against a
+real InferenceServer on the doc-evidence MLP) and asserts the mechanics
+the acceptance criteria care about: the batcher engages (avg batch rows
+> 1), throughput is finite, zero steady-state recompiles, and the JSON
+artifact schema matches what BENCH_serving.json records. Wall-clock
+speedup is recorded by the full bench, not asserted here — shared CI
+hosts are too noisy for a hard ratio gate (same policy as
+test_trainer_step_bench).
+"""
+import importlib
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _load_bench():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "perf"))
+    try:
+        return importlib.import_module("serve_bench")
+    finally:
+        sys.path.pop(0)
+
+
+def test_serve_bench_quick(tmp_path):
+    bench = _load_bench()
+    results = bench.run(quick=True)
+    assert "mlp" in results
+    r = results["mlp"]
+    for k in ("sequential_rps", "served_rps", "speedup", "p50_ms",
+              "p95_ms", "p99_ms", "avg_batch_rows", "occupancy",
+              "bucket_compiles", "steady_state_recompiles"):
+        assert k in r, "missing %s" % k
+    assert np.isfinite(r["sequential_rps"]) and r["sequential_rps"] > 0
+    assert np.isfinite(r["served_rps"]) and r["served_rps"] > 0
+    assert r["avg_batch_rows"] > 1, "the dynamic batcher never coalesced"
+    assert r["steady_state_recompiles"] == 0, \
+        "bucketed serving recompiled after warmup"
+    assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
+
+    # artifact schema: what the driver's BENCH_serving.json consumers read
+    path = str(tmp_path / "BENCH_serving.json")
+    with open(path, "w") as f:
+        json.dump({"bench": "serving", "results": results}, f)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["bench"] == "serving"
+    assert loaded["results"]["mlp"]["served_rps"] == r["served_rps"]
