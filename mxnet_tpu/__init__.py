@@ -63,6 +63,19 @@ from .initializer import Xavier, Uniform, Normal, Orthogonal, Zero, One, Constan
 
 config._apply_import_knobs()
 
+
+def __getattr__(name):
+    # mx.analysis resolves lazily (PEP 562): the analyzer must never load
+    # unless used — the MXNET_TPU_ANALYZE=off bind path is asserted to be
+    # import-free (tests/test_analysis.py::test_analyze_off_is_zero_cost).
+    # importlib, NOT `from . import analysis`: the fromlist form re-enters
+    # this __getattr__ via importlib._handle_fromlist -> infinite recursion
+    if name == "analysis":
+        import importlib
+        return importlib.import_module(".analysis", __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
 __version__ = "0.1.0"
 
 __all__ = [

@@ -1,0 +1,53 @@
+"""``mxnet_tpu.analysis`` — static graph/program analysis.
+
+Three analyzers over the two-language design (ISSUE 3; see
+``docs/architecture/analysis.md``):
+
+* :func:`analyze_symbol` — graph passes over ``Symbol`` DAGs run pre-bind
+  (cycle / dup-name / dead-node / shape-error / cost-model). Also exposed
+  as ``Symbol.analyze()`` and ``Module.analyze()``.
+* :func:`analyze_program` — jaxpr hazard checks run post-trace
+  (baked-const / f64-promotion / host-callback / donation).
+* :func:`lint_paths` — AST concurrency/perf lint for the codebase itself
+  (lock-host-sync / lock-dispatch / wall-clock), with inline
+  ``# mx-lint: allow(code)`` suppressions and a CI baseline.
+
+Bind-time enforcement rides the ``MXNET_TPU_ANALYZE=off|warn|strict`` knob
+(:func:`check_bind`, called from ``Executor.__init__``): ``warn`` logs
+WARNING+ findings, ``strict`` raises ``MXNetError`` on ERROR findings.
+The knob defaults to ``off`` and the Executor hook imports this package
+lazily, so analysis is strictly zero-cost when disabled (asserted by
+``tests/test_analysis.py::test_analyze_off_is_zero_cost``).
+
+Every finding increments an always-on profiler counter for its hazard
+class (``analysis_<code>``), so hazard rates are observable fleet-wide
+without holding Report objects.
+
+CLI: ``python -m mxnet_tpu.analysis {graph,lint,self-check} ...``.
+"""
+from __future__ import annotations
+
+from .findings import Finding, Report, Severity
+from .graph_passes import GRAPH_PASSES, analyze_symbol
+from .program_passes import analyze_jaxpr, analyze_program
+from .lint import (baseline_key, diff_baseline, lint_paths, lint_source,
+                   load_baseline, write_baseline)
+
+__all__ = [
+    "Finding", "Report", "Severity",
+    "analyze_symbol", "analyze_program", "analyze_jaxpr",
+    "lint_paths", "lint_source",
+    "load_baseline", "write_baseline", "diff_baseline", "baseline_key",
+    "check_bind", "GRAPH_PASSES",
+]
+
+
+def check_bind(symbol, input_shapes=None, input_dtypes=None,
+               mode: str = "warn", context: str = "bind") -> Report:
+    """The bind-time verification hook (``MXNET_TPU_ANALYZE``): run the
+    graph passes with the bind's shapes and enforce the mode contract —
+    ``warn`` logs, ``strict`` raises on ERROR findings. Returns the Report
+    so callers (tests, tools) can inspect what fired."""
+    report = analyze_symbol(symbol, input_shapes=input_shapes,
+                            input_dtypes=input_dtypes, context=context)
+    return report.enforce(mode)

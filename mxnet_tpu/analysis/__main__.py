@@ -1,0 +1,157 @@
+"""``python -m mxnet_tpu.analysis`` — the analyzer CLI.
+
+Subcommands:
+
+* ``graph <symbol.json | zoo:name>`` — run the graph passes over a saved
+  symbol JSON or a model-zoo net (``zoo:resnet18``, ``zoo:mlp``,
+  ``zoo:transformer``), with ``--shape name=1,3,224,224`` bindings.
+* ``lint <paths...>`` — the AST concurrency/perf lint; ``--baseline``
+  fails only on findings NOT in the baseline file, ``--write-baseline``
+  regenerates it.
+* ``self-check`` — the CI gate: model-zoo nets must analyze with zero
+  ERROR-level findings.
+
+Exit status: 0 clean, 1 findings at/above the failure threshold
+(``--fail-on``, default ERROR for ``graph``; any non-baseline finding for
+``lint``), 2 usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .findings import Severity
+
+__all__ = ["main"]
+
+
+def _parse_shapes(specs):
+    shapes = {}
+    for spec in specs or ():
+        if "=" not in spec:
+            raise SystemExit("--shape expects name=d0,d1,... got %r" % spec)
+        name, dims = spec.split("=", 1)
+        shapes[name] = tuple(int(d) for d in dims.split(",") if d)
+    return shapes
+
+
+def _zoo_symbol(name: str):
+    """Small-config model-zoo builds: fast to analyze, same op surface as
+    the production sizes."""
+    from .. import models
+    from ..models import transformer as _transformer
+    if name.startswith("resnet"):
+        layers = int(name[len("resnet"):] or 8)
+        return (models.get_resnet(num_classes=10, num_layers=layers,
+                                  image_shape="3,32,32"),
+                {"data": (2, 3, 32, 32), "softmax_label": (2,)})
+    if name == "mlp":
+        from ..models import mlp
+        return (mlp.get_symbol(num_classes=10),
+                {"data": (2, 784), "softmax_label": (2,)})
+    if name == "transformer":
+        return (_transformer.get_symbol(vocab_size=128, num_layers=2,
+                                        d_model=32, n_heads=2, seq_len=16),
+                {"data": (2, 16), "softmax_label": (2, 16)})
+    raise SystemExit("unknown zoo model %r (try resnet8, resnet20, mlp, "
+                     "transformer)" % name)
+
+
+def _cmd_graph(args) -> int:
+    from . import analyze_symbol
+    if args.target.startswith("zoo:"):
+        sym, shapes = _zoo_symbol(args.target[4:])
+        shapes.update(_parse_shapes(args.shape))
+    else:
+        from ..symbol import load
+        sym = load(args.target)
+        shapes = _parse_shapes(args.shape)
+    report = analyze_symbol(sym, input_shapes=shapes or None,
+                            context=args.target)
+    print(report.format(min_severity=Severity[args.min_severity]))
+    fail_at = Severity[args.fail_on]
+    return 1 if report.at_least(fail_at) else 0
+
+
+def _cmd_lint(args) -> int:
+    from . import diff_baseline, lint_paths, load_baseline, write_baseline
+    root = os.path.abspath(args.root)
+    report = lint_paths(args.paths)
+    if args.write_baseline:
+        n_keys = write_baseline(report, args.write_baseline, root)
+        print("wrote %d finding key(s) (%d finding(s)) to %s"
+              % (n_keys, len(report), args.write_baseline))
+        return 0
+    if args.baseline:
+        fresh = diff_baseline(report, load_baseline(args.baseline), root)
+        known = len(report) - len(fresh)
+        if not fresh:
+            print("lint: no new findings (%d baselined)" % known)
+            return 0
+        print("lint: %d NEW finding(s) (%d baselined):" % (len(fresh),
+                                                           known))
+        for f in fresh:
+            print(f.format())
+        return 1
+    print(report.format())
+    return 1 if report.findings else 0
+
+
+def _cmd_self_check(args) -> int:
+    """Model-zoo nets must produce zero ERROR-level graph findings — the
+    analyzer's own regression gate (a pass that starts mis-firing on known
+    -good nets fails CI here, not in user binds)."""
+    from . import analyze_symbol
+    failed = 0
+    for name in ("resnet8", "mlp", "transformer"):
+        sym, shapes = _zoo_symbol(name)
+        report = analyze_symbol(sym, input_shapes=shapes, context=name)
+        errs = report.errors
+        status = "FAIL (%d errors)" % len(errs) if errs else "ok"
+        cost = report.extras.get("cost", {})
+        print("%-12s %-18s %.3g GFLOP, est peak %.3g MB"
+              % (name, status, cost.get("flops", 0) / 1e9,
+                 cost.get("peak_bytes", 0) / 1e6))
+        for f in errs:
+            print("  " + f.format())
+        failed += bool(errs)
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m mxnet_tpu.analysis",
+                                description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("graph", help="graph passes over a symbol")
+    g.add_argument("target", help="symbol JSON path or zoo:<name>")
+    g.add_argument("--shape", action="append",
+                   help="input shape binding name=d0,d1,... (repeatable)")
+    g.add_argument("--min-severity", default="INFO",
+                   choices=[s.name for s in Severity])
+    g.add_argument("--fail-on", default="ERROR",
+                   choices=[s.name for s in Severity])
+    g.set_defaults(fn=_cmd_graph)
+
+    l = sub.add_parser("lint", help="AST concurrency/perf lint")
+    l.add_argument("paths", nargs="+")
+    l.add_argument("--baseline", help="fail only on findings not in this "
+                                      "baseline JSON")
+    l.add_argument("--write-baseline", help="regenerate the baseline file "
+                                            "and exit 0")
+    l.add_argument("--root", default=".",
+                   help="path findings are keyed relative to (default .)")
+    l.set_defaults(fn=_cmd_lint)
+
+    s = sub.add_parser("self-check",
+                       help="model zoo must analyze with zero ERRORs")
+    s.set_defaults(fn=_cmd_self_check)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

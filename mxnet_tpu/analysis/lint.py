@@ -1,0 +1,256 @@
+"""AST-based concurrency/perf lint for the codebase itself.
+
+The serving and executor layers mix Python locks with device dispatch, and
+the exact shapes that caused PR 2's deadlock and latency bugs are visible
+in the AST without running anything:
+
+* ``lock-host-sync`` (ERROR) — a host sync (``.asnumpy()``,
+  ``block_until_ready``, ``jax.device_get``, ``future.result()``) while a
+  lock/condition is held: every other thread needing that lock now waits
+  on the device, and if the synced computation needs the lock-holder
+  (callback re-entry) the process deadlocks — the PR 2 train_rcnn shape.
+* ``lock-dispatch`` (WARNING) — jax dispatch (``jax.*``/``jnp.*`` calls,
+  ``nd.array``) under a lock: serializes the accelerator behind a Python
+  mutex and widens every race window.
+* ``wall-clock`` (WARNING) — ``time.time()`` in latency/throughput math:
+  wall clocks jump with NTP; deadlines and p99s must use
+  ``time.monotonic()``/``perf_counter()``.
+
+Intentional sites are suppressed inline with ``# mx-lint: allow(<code>)``
+(on the offending line or the enclosing ``with`` line); historical debt is
+carried by a checked-in baseline (:func:`load_baseline`/:func:`diff_baseline`)
+so CI fails only on NEW findings.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Finding, Report, Severity
+
+__all__ = ["lint_paths", "lint_source", "load_baseline", "write_baseline",
+           "diff_baseline", "baseline_key"]
+
+_LOCK_NAME = re.compile(r"(lock|cond|mutex|sem)", re.IGNORECASE)
+_ALLOW = re.compile(r"#\s*mx-lint:\s*allow\(([\w\s,-]+)\)")
+
+# attribute-call names that synchronize with the device / block the thread
+_HOST_SYNC_METHODS = {"asnumpy", "wait_to_read", "block_until_ready",
+                      "device_get", "item", "result"}
+# module roots whose calls dispatch device work
+_DISPATCH_ROOTS = {"jax", "jnp"}
+_DISPATCH_ARRAY_FNS = {"array", "asarray", "device_put"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name rendering of a call target / with-context."""
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return "%s.%s" % (base, node.attr) if base else node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _dotted(node.func)
+    return ""
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    return bool(_LOCK_NAME.search(_dotted(expr)))
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, report: Report):
+        self.path = path
+        self.lines = source.splitlines()
+        self.report = report
+        self.lock_stack: List[Tuple[str, int]] = []   # (lock name, line)
+        self.func_stack: List[str] = []
+
+    # ------------------------------------------------------- suppression
+    def _allowed(self, code: str, *lines: int) -> bool:
+        for ln in lines:
+            if ln is None or not (1 <= ln <= len(self.lines)):
+                continue
+            m = _ALLOW.search(self.lines[ln - 1])
+            if m and code in [c.strip() for c in m.group(1).split(",")]:
+                return True
+        return False
+
+    def _add(self, code: str, severity: Severity, message: str,
+             line: int) -> None:
+        lock_lines = [ln for _, ln in self.lock_stack]
+        if self._allowed(code, line, *lock_lines):
+            return
+        self.report.add(code, severity, message, path=self.path, line=line,
+                        func=".".join(self.func_stack) or "<module>")
+
+    # -------------------------------------------------------- traversal
+    def visit_ClassDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        # a new function body does NOT inherit the enclosing with-lock
+        # textually... but nested defs under `with lock:` are usually
+        # callbacks invoked elsewhere — reset the lock context for them
+        saved, self.lock_stack = self.lock_stack, []
+        self.generic_visit(node)
+        self.lock_stack = saved
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        # a lambda built under a lock runs later, outside it — same
+        # deferred-callback reset as nested defs
+        saved, self.lock_stack = self.lock_stack, []
+        self.generic_visit(node)
+        self.lock_stack = saved
+
+    def visit_With(self, node):
+        held = [(_dotted(item.context_expr), item.context_expr.lineno)
+                for item in node.items if _is_lock_expr(item.context_expr)]
+        self.lock_stack.extend(held)
+        self.generic_visit(node)
+        if held:
+            del self.lock_stack[-len(held):]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        name = _dotted(node.func)
+        leaf = name.rsplit(".", 1)[-1]
+        root = name.split(".", 1)[0]
+        line = node.lineno
+
+        if name == "time.time":
+            self._add(
+                "wall-clock", Severity.WARNING,
+                "time.time() is wall-clock (jumps with NTP) — use "
+                "time.monotonic()/perf_counter() for latency/deadline "
+                "math", line)
+
+        if self.lock_stack:
+            locks = ", ".join(l for l, _ in self.lock_stack)
+            if leaf in _HOST_SYNC_METHODS or name in (
+                    "jax.block_until_ready", "jax.device_get"):
+                self._add(
+                    "lock-host-sync", Severity.ERROR,
+                    "host sync %r while holding lock(s) [%s] — other "
+                    "threads queue behind the device, and callback "
+                    "re-entry deadlocks (the PR 2 train_rcnn shape)"
+                    % (name + "()", locks), line)
+            elif root in _DISPATCH_ROOTS or (
+                    leaf in _DISPATCH_ARRAY_FNS and
+                    root in ("nd", "nd_mod", "ndarray", "jax", "jnp")):
+                self._add(
+                    "lock-dispatch", Severity.WARNING,
+                    "jax dispatch %r under lock(s) [%s] — the accelerator "
+                    "is serialized behind a Python mutex" % (name, locks),
+                    line)
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>",
+                report: Optional[Report] = None) -> Report:
+    report = report if report is not None else Report(context="lint")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        report.add("parse-error", Severity.ERROR,
+                   "cannot parse: %s" % exc, path=path,
+                   line=exc.lineno or 0)
+        return report
+    _FileLinter(path, source, report).visit(tree)
+    return report
+
+
+def lint_paths(paths, report: Optional[Report] = None,
+               exclude=("native/vendor",)) -> Report:
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    report = report if report is not None else Report(context="lint")
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for f in sorted(filenames):
+                full = os.path.join(dirpath, f)
+                if f.endswith(".py") and not any(e in full
+                                                 for e in exclude):
+                    files.append(full)
+    for f in sorted(files):
+        with open(f, "r", encoding="utf-8") as fh:
+            lint_source(fh.read(), path=f, report=report)
+    return report
+
+
+# ------------------------------------------------------------------ baseline
+# Keys are (relpath, code, enclosing function) with a count — stable under
+# line-number drift, so refactors that merely move debt don't churn the
+# file, while any NEW site in a function bumps its count and fails CI.
+
+
+def baseline_key(f: Finding, root: str) -> str:
+    rel = os.path.relpath(f.path, root) if f.path else "<none>"
+    return "%s::%s::%s" % (rel.replace(os.sep, "/"), f.code,
+                           f.func or "<module>")
+
+
+def _key_counts(report: Report, root: str) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in report:
+        if f.code == "cost-model":
+            continue
+        k = baseline_key(f, root)
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def write_baseline(report: Report, path: str, root: str) -> int:
+    """Write the aggregated baseline; returns the number of KEYS written
+    (several same-key findings collapse into one counted key)."""
+    payload = {
+        "__doc__": "mx-lint baseline: known findings keyed by "
+                   "path::code::function with counts; CI fails only when "
+                   "a key's count exceeds its baseline. Regenerate with "
+                   "`python -m mxnet_tpu.analysis lint <paths> "
+                   "--write-baseline <file>`.",
+        "findings": _key_counts(report, root),
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(payload["findings"])
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path) as fh:
+        payload = json.load(fh)
+    return {k: int(v) for k, v in payload.get("findings", {}).items()}
+
+
+def diff_baseline(report: Report, baseline: Dict[str, int],
+                  root: str) -> List[Finding]:
+    """Findings NOT covered by the baseline (per-key overflow keeps the
+    textually-last findings of that key, which skews new-at-the-bottom —
+    good enough for a gate whose fix is 'look at this function')."""
+    budget = dict(baseline)
+    fresh: List[Finding] = []
+    for f in report:
+        if f.code == "cost-model":
+            continue
+        k = baseline_key(f, root)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            fresh.append(f)
+    return fresh

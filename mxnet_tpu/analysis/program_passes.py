@@ -1,0 +1,267 @@
+"""Post-trace hazard checks over jaxprs — the program-layer audits.
+
+Where the graph passes verify the declarative Symbol, these verify what a
+trace actually *captured* — the hazards that produced PR 1's and PR 2's
+production bugs are all visible in the jaxpr:
+
+* ``baked-const`` — closure-captured constants baked into the program.
+  Big ones bloat every executable and re-upload per compile; ANY captured
+  constant is a cache-identity hazard (the PR 1 ``Scale(2.0)``/
+  ``Scale(3.0)`` OpDef collision: two closures over different constants
+  aliased onto one compiled program).
+* ``f64-promotion`` — a program whose *inputs* are sub-f64 floats but
+  which computes in float64 somewhere (a numpy scalar or python float
+  promoted under x64): 2x memory + emulated arithmetic on TPU.
+* ``host-callback`` — ``pure_callback``/``io_callback`` primitives force
+  the synchronous dispatch path (the PR 2 train_rcnn deadlock shape).
+* ``donation`` — donated inputs that are returned unchanged (the caller's
+  buffer is invalidated while an output aliases it) or never consumed.
+
+``analyze_program(fn, *args)`` traces with ``jax.make_jaxpr`` (jitted
+functions trace through) and walks every sub-jaxpr (pjit/scan/cond/
+custom_vjp bodies) recursively.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .findings import Report, Severity
+
+__all__ = ["analyze_program", "analyze_jaxpr"]
+
+# captured consts >= the warn bound get flagged; >= the error bound they
+# are compile-time/HBM hazards in their own right
+CONST_BYTES_WARN = 1 << 16       # 64 KiB
+CONST_BYTES_ERROR = 1 << 26      # 64 MiB
+
+_CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "python_callback",
+                        "outside_call", "host_callback_call")
+
+
+def _iter_jaxprs(jaxpr) -> Iterable[Tuple[Any, List[Any]]]:
+    """Yield (jaxpr, consts) for a jaxpr and every sub-jaxpr reachable
+    through eqn params (pjit, scan, while, cond branches, custom_vjp)."""
+    from jax._src import core as _core
+
+    seen = set()
+
+    def walk(j, consts):
+        if id(j) in seen:
+            return
+        seen.add(id(j))
+        yield j, consts
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                for sub in _as_jaxprs(v):
+                    yield from walk(*sub)
+
+    def _as_jaxprs(v):
+        if isinstance(v, _core.ClosedJaxpr):
+            yield (v.jaxpr, list(v.consts))
+        elif isinstance(v, _core.Jaxpr):
+            yield (v, [])
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from _as_jaxprs(x)
+
+    yield from walk(jaxpr, [])
+
+
+def _unwrap_pjit(closed):
+    """Peel the trivial outer pjit wrapper ``make_jaxpr(jit(f))`` builds, so
+    invar positions line up with the user's flattened arguments and consts
+    are visible at the top level."""
+    j = closed
+    while len(j.jaxpr.eqns) == 1 and \
+            j.jaxpr.eqns[0].primitive.name in ("pjit", "jit"):
+        eqn = j.jaxpr.eqns[0]
+        inner = eqn.params.get("jaxpr")
+        if inner is None or list(eqn.invars) != list(j.jaxpr.invars) or \
+                len(eqn.outvars) != len(j.jaxpr.outvars):
+            break
+        j = inner
+    return j
+
+
+def _const_bytes(c) -> int:
+    nbytes = getattr(c, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    try:
+        return int(np.asarray(c).nbytes)
+    except Exception:                                       # noqa: BLE001
+        return 0
+
+
+def _aval_of(v):
+    return getattr(v, "aval", None)
+
+
+def _is_float(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.floating)
+
+
+# ------------------------------------------------------------------ passes
+
+
+def _check_baked_consts(report, jaxprs, const_bytes_warn,
+                        const_bytes_error):
+    for j, consts in jaxprs:
+        for cv, c in zip(j.constvars, consts):
+            n = _const_bytes(c)
+            if n < const_bytes_warn:
+                continue
+            sev = Severity.ERROR if n >= const_bytes_error \
+                else Severity.WARNING
+            aval = _aval_of(cv)
+            report.add(
+                "baked-const", sev,
+                "closure-captured constant %s%s (%d bytes) is baked into "
+                "the program — pass it as an argument: baked constants "
+                "bloat every executable, re-upload per compile, and make "
+                "the closure part of the program's identity (the PR 1 "
+                "OpDef signature-collision shape)"
+                % (getattr(aval, "dtype", type(c).__name__),
+                   list(getattr(aval, "shape", ())), n),
+                detail={"nbytes": n,
+                        "shape": list(getattr(aval, "shape", ()))})
+
+
+def _check_f64(report, main, jaxprs):
+    in_dtypes = [getattr(_aval_of(v), "dtype", None)
+                 for v in main.invars]
+    float_ins = [d for d in in_dtypes if d is not None and _is_float(d)]
+    if not float_ins or all(np.dtype(d) == np.float64 for d in float_ins):
+        return   # no float inputs, or intentionally f64 end-to-end
+    for j, consts in jaxprs:
+        for cv, c in zip(j.constvars, consts):
+            if getattr(c, "dtype", None) is not None and \
+                    np.dtype(c.dtype) == np.float64:
+                report.add(
+                    "f64-promotion", Severity.WARNING,
+                    "float64 constant %s captured in a program with %s "
+                    "inputs — arithmetic promotes to f64 (2x memory, "
+                    "emulated on TPU); cast the constant or use a python "
+                    "float" % (list(getattr(c, "shape", ())),
+                               sorted({str(d) for d in float_ins})))
+                return
+        for eqn in j.eqns:
+            for ov in eqn.outvars:
+                aval = _aval_of(ov)
+                dt = getattr(aval, "dtype", None)
+                if dt is None or np.dtype(dt) != np.float64 or \
+                        not _is_float(dt):
+                    continue
+                srcs = sorted({
+                    str(getattr(_aval_of(iv), "dtype", "?"))
+                    for iv in eqn.invars if _aval_of(iv) is not None})
+                if "float64" in srcs:
+                    continue   # promotion happened upstream; report once
+                report.add(
+                    "f64-promotion", Severity.WARNING,
+                    "primitive %r promotes %s to float64 — a numpy "
+                    "scalar/f64 literal leaked into an f32 program under "
+                    "x64 (2x memory, emulated arithmetic on TPU)"
+                    % (eqn.primitive.name, srcs or ["(consts)"]),
+                    detail={"primitive": eqn.primitive.name,
+                            "input_dtypes": srcs})
+                return
+
+
+def _check_host_callbacks(report, jaxprs):
+    found = {}
+    for j, _ in jaxprs:
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if any(name.startswith(p) for p in _CALLBACK_PRIMITIVES):
+                found[name] = found.get(name, 0) + 1
+    for name, count in found.items():
+        report.add(
+            "host-callback", Severity.WARNING,
+            "%d %r primitive(s) in the program — host callbacks force "
+            "synchronous dispatch with the frontend (the PR 2 sync path: "
+            "executor._sync_host_callbacks) and stall the accelerator "
+            "pipeline every step" % (count, name),
+            detail={"primitive": name, "count": count})
+
+
+def _check_donation(report, main, donate_argnums, n_args):
+    if not donate_argnums:
+        return
+    donate = set(int(i) for i in donate_argnums)
+    bad = [i for i in donate if i >= len(main.invars)]
+    if bad or n_args != len(main.invars):
+        # flattened-arg mismatch (pytree args): positions are ambiguous,
+        # refuse to guess rather than mis-report
+        report.add(
+            "donation", Severity.INFO,
+            "cannot map donate_argnums %s onto %d flattened invars — "
+            "donation audit skipped (pass flat array arguments)"
+            % (sorted(donate), len(main.invars)))
+        return
+    outset = {id(v) for v in main.outvars}
+    used = {id(iv) for eqn in main.eqns for iv in eqn.invars}
+    for i in sorted(donate):
+        v = main.invars[i]
+        if id(v) in outset:
+            report.add(
+                "donation", Severity.ERROR,
+                "donated argument %d is returned unchanged — XLA aliases "
+                "the output onto the donated buffer while the caller's "
+                "array is invalidated (donation-after-use: any later read "
+                "of the input OR the aliased output observes garbage)"
+                % i, detail={"argnum": i})
+        elif id(v) not in used:
+            report.add(
+                "donation", Severity.WARNING,
+                "donated argument %d is never consumed by the program — "
+                "the caller's buffer is destroyed for nothing (drop it "
+                "from donate_argnums)" % i, detail={"argnum": i})
+
+
+# -------------------------------------------------------------- entry points
+
+
+def analyze_jaxpr(closed_jaxpr, donate_argnums=(), n_args: Optional[int] = None,
+                  const_bytes_warn: int = CONST_BYTES_WARN,
+                  const_bytes_error: int = CONST_BYTES_ERROR,
+                  context: str = "program") -> Report:
+    """Run the program passes over an already-traced ``ClosedJaxpr``."""
+    report = Report(context=context)
+    main = _unwrap_pjit(closed_jaxpr)
+    jaxprs = list(_iter_jaxprs(main.jaxpr))
+    # the top ClosedJaxpr's consts belong to its own jaxpr's constvars
+    jaxprs[0] = (main.jaxpr, list(main.consts))
+    _check_baked_consts(report, jaxprs, const_bytes_warn, const_bytes_error)
+    _check_f64(report, main.jaxpr, jaxprs)
+    _check_host_callbacks(report, jaxprs)
+    _check_donation(report, main.jaxpr, donate_argnums,
+                    len(main.jaxpr.invars) if n_args is None else n_args)
+    return report
+
+
+def analyze_program(fn, *args, donate_argnums=(),
+                    const_bytes_warn: int = CONST_BYTES_WARN,
+                    const_bytes_error: int = CONST_BYTES_ERROR,
+                    context: str = "program", **kwargs) -> Report:
+    """Trace ``fn(*args, **kwargs)`` and audit the captured program.
+
+    ``fn`` may be a plain function, a jitted function (traced through), or
+    an already-made ``ClosedJaxpr`` (then ``args`` are ignored). The trace
+    is abstract — no FLOPs run, no executable is built.
+    """
+    import jax
+    from jax._src import core as _core
+
+    if isinstance(fn, _core.ClosedJaxpr):
+        closed = fn
+        n_args = None
+    else:
+        closed = jax.make_jaxpr(fn)(*args, **kwargs)
+        n_args = len(jax.tree_util.tree_leaves(args))
+    return analyze_jaxpr(closed, donate_argnums=donate_argnums,
+                         n_args=n_args, const_bytes_warn=const_bytes_warn,
+                         const_bytes_error=const_bytes_error,
+                         context=context)

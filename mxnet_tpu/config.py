@@ -111,6 +111,23 @@ register("MXNET_TPU_SERVE_MAX_DELAY_US", int, 2000,
 register("MXNET_TPU_SERVE_QUEUE_BOUND", int, 1024,
          "serve: default admission bound; submit() load-sheds (QueueFull) "
          "when this many requests are already queued")
+def _parse_analyze_mode(v) -> str:
+    s = str(v).strip().lower()
+    if s in ("", "0", "off", "false", "no", "none"):
+        return "off"
+    if s in ("warn", "warning", "1", "on", "true", "yes"):
+        return "warn"
+    if s == "strict":
+        return "strict"
+    raise ValueError(
+        "MXNET_TPU_ANALYZE must be off|warn|strict, got %r" % (v,))
+
+
+register("MXNET_TPU_ANALYZE", _parse_analyze_mode, "off",
+         "run mxnet_tpu.analysis graph passes at Executor/Module bind: "
+         "off = analyzer never imported (zero cost), warn = log "
+         "WARNING+ findings, strict = raise MXNetError on ERROR "
+         "findings before any compile")
 register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
          "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
          "one-pass E[x^2]-E[x]^2 form — restores precision for "

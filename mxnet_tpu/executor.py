@@ -172,6 +172,22 @@ class Executor:
                      if self._grad_req.get(n, "null") != "null"
                      and n in self.grad_dict]
 
+        # bind-time static analysis (ISSUE 3): graph passes run over the
+        # symbol with the bound shapes BEFORE any trace/compile. Gated on
+        # the MXNET_TPU_ANALYZE knob with a lazy import so the default
+        # (off) pays one dict lookup and never imports the analyzer.
+        from . import config as _config
+        _analyze_mode = _config.get("MXNET_TPU_ANALYZE")
+        if _analyze_mode != "off":
+            from .analysis import check_bind as _check_bind
+            shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+            shapes.update(
+                {n: tuple(a.shape) for n, a in self.aux_dict.items()})
+            dtypes = {n: a.dtype for n, a in self.arg_dict.items()}
+            _check_bind(symbol, input_shapes=shapes,
+                        input_dtypes=dtypes, mode=_analyze_mode,
+                        context="bind")
+
         self._group2ctx = group2ctx
         self._shared_exec = shared_exec
         self._fn = graph_function(symbol, self._node_device_fn())

@@ -277,7 +277,7 @@ class BaseModule(object):
         fused = getattr(self, "_fit_step", None)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            tic = time.perf_counter()
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
@@ -312,7 +312,7 @@ class BaseModule(object):
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
+            toc = time.perf_counter()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
 
             arg_params_, aux_params_ = self.get_params()

@@ -321,6 +321,22 @@ class Module(BaseModule):
                              aux_params=shared_module._aux_params,
                              allow_missing=False, force_init=True)
 
+    # -------------------------------------------------------------- analysis
+    def analyze(self, input_shapes=None, input_dtypes=None):
+        """Run the static graph analyzer (``mxnet_tpu.analysis``) over this
+        module's symbol. Bound modules analyze with their actual bound
+        shapes; unbound ones need ``input_shapes``. Returns an
+        ``analysis.Report`` (lazy import — never loaded unless called)."""
+        from ..analysis import analyze_symbol
+        shapes = {k: tuple(v) for k, v in (input_shapes or {}).items()}
+        if not shapes and self.binded:
+            shapes = {n: tuple(a.shape)
+                      for n, a in self._exec.arg_dict.items()}
+            shapes.update({n: tuple(a.shape)
+                           for n, a in self._exec.aux_dict.items()})
+        return analyze_symbol(self._symbol, input_shapes=shapes or None,
+                              input_dtypes=input_dtypes, context="module")
+
     # ------------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

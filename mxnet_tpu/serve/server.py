@@ -478,7 +478,10 @@ class InferenceServer:
         NDArrays would dispatch one eager device op per request, which
         measured ~10x the whole batched forward at MLP sizes. The fetch
         doubles as the device sync, so recorded latency is real."""
-        with self._model_lock:
+        # the lock-held host sync is the design here: all model
+        # invocations serialize on _model_lock (shared executor state),
+        # and fetching inside it is what makes recorded latency real
+        with self._model_lock:  # mx-lint: allow(lock-host-sync)
             outs = self._model(x)
             outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
             if self._single_output is None:

@@ -33,8 +33,8 @@ def kill_local(pattern, grace=3.0):
             os.kill(pid, signal.SIGTERM)
         except ProcessLookupError:
             pass
-    deadline = time.time() + grace
-    while time.time() < deadline and _local_pids(pattern):
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline and _local_pids(pattern):
         time.sleep(0.2)
     for pid in _local_pids(pattern):
         try:
