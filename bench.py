@@ -42,9 +42,15 @@ def _note(msg):
 sys.path.insert(0, __file__.rsplit("/", 1)[0] if "/" in __file__ else ".")
 
 BASELINE_IMG_S = 181.53   # P100 training, ResNet-50 batch 32
-BATCH = 256
-WARMUP = 3
-ITERS = 20
+# Round-6 shrink: round 5 timed out (rc 124) with the resnet section at
+# "bind start" for 25+ min on the axon platform — batch 128 and a shorter
+# timed window keep the whole section inside BENCH_SECTION_TIMEOUT_SECS
+# while img/s (a per-image rate) stays comparable across rounds; bind_secs
+# is now recorded per section so bind-time regressions show up in the
+# trajectory instead of as silent timeouts.
+BATCH = 128
+WARMUP = 2
+ITERS = 12
 SECTIONS = ("resnet", "transformer")
 
 # Analytic model FLOPs: ResNet-50 @224x224 forward = 4.089e9 multiply-adds
@@ -84,6 +90,7 @@ def section_transformer():
     L, D, H, T, V = 12, 2048, 16, 1024, 32000
     B = 8
     _note("bench: transformer bind start")
+    t_bind = time.perf_counter()
     sym = transformer.get_symbol(vocab_size=V, num_layers=L, d_model=D,
                                  n_heads=H, seq_len=T, attention="flash")
     mod = mx.mod.Module(sym, context=mx.tpu(0))
@@ -92,6 +99,7 @@ def section_transformer():
     mod.init_params(mx.init.Xavier())
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.01})
+    bind_secs = round(time.perf_counter() - t_bind, 3)
     rng = np.random.RandomState(0)
     x = rng.randint(0, V, (B, T)).astype(np.float32)
     y = rng.randint(0, V, (B, T)).astype(np.float32)
@@ -119,7 +127,8 @@ def section_transformer():
     n_embed = V * D + T * D
     flops_per_tok = 6 * (n_params - n_embed) + 12 * L * D * T
     mfu = round(tok_s * flops_per_tok / peak, 4) if peak else None
-    return {"transformer_tok_s": round(tok_s, 1), "transformer_mfu": mfu}
+    return {"transformer_tok_s": round(tok_s, 1), "transformer_mfu": mfu,
+            "bind_secs": bind_secs}
 
 
 def section_resnet():
@@ -135,6 +144,7 @@ def section_resnet():
 
     mx.amp.init("bfloat16")   # bf16 MXU compute, fp32 master weights
     _note("bench: resnet bind start")
+    t_bind = time.perf_counter()
 
     # space-to-depth stem: mathematically identical to the 7x7/2 stem
     # on the same parameter, ~2 ms/step faster (docs/perf.md round-5
@@ -148,6 +158,8 @@ def section_resnet():
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.05,
                                          "momentum": 0.9, "wd": 1e-4})
+    bind_secs = round(time.perf_counter() - t_bind, 3)
+    _note("bench: resnet bound in %.1fs" % bind_secs)
 
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
@@ -189,6 +201,7 @@ def section_resnet():
         "batch": batch,
         "flops_per_img": TRAIN_FLOPS_PER_IMG,
         "peak_flops": peak,
+        "bind_secs": bind_secs,
     }
 
 
@@ -207,6 +220,7 @@ def _merge(records):
         "vs_baseline": None, "mfu": None, "batch": None,
         "flops_per_img": TRAIN_FLOPS_PER_IMG, "peak_flops": None,
         "transformer_tok_s": None, "transformer_mfu": None,
+        "bind_secs": {},
     }
     errors = {}
     for name, rec in records.items():
@@ -214,8 +228,12 @@ def _merge(records):
             errors[name] = rec["error"]
             continue
         for k in merged:
-            if k in rec:
+            if k != "bind_secs" and k in rec:
                 merged[k] = rec[k]
+        if rec.get("bind_secs") is not None:
+            # per-section bind time: the round-5 wedge was a 25-min bind,
+            # invisible in a throughput-only record
+            merged["bind_secs"][name] = rec["bind_secs"]
     if errors:
         merged["errors"] = errors
     return merged

@@ -40,8 +40,47 @@ from . import profiler as _profiler
 
 __all__ = [
     "CompileCache", "Uncacheable", "op_identity", "fn_token", "static_key",
-    "aval_key", "structural_failure", "FusedUpdater",
+    "aval_key", "structural_failure", "FusedUpdater", "InflightWindow",
 ]
+
+
+class InflightWindow:
+    """Bounded in-flight dispatch for the async fit loop.
+
+    jax dispatch is asynchronous: the host can race arbitrarily far ahead
+    of the device, queueing batches and executions without bound. This
+    window holds one completion token (the step's output arrays) per
+    dispatched step; pushing past ``depth`` blocks on the OLDEST step — a
+    sliding-window sync that caps in-flight work at ``depth`` steps while
+    keeping the device queue full (waiting on step ``i-K`` is flow
+    control, not a pipeline break: ``K`` steps stay queued behind it).
+
+    Donation safety rides on the same ordering: the fused step donates the
+    *previous* step's output buffers (params/states swap through
+    ``arg_dict`` every step, so no buffer is ever donated twice), and the
+    window guarantees at most ``depth+1`` generations of parameters are
+    live at once.
+    """
+
+    def __init__(self, depth: int):
+        self.depth = int(depth)
+        self._fifo: List[Any] = []
+
+    def push(self, token) -> None:
+        if token is None or self.depth <= 0:
+            return
+        self._fifo.append(token)
+        if len(self._fifo) > self.depth:
+            _profiler.incr_counter("loop_window_wait")
+            jax.block_until_ready(self._fifo.pop(0))
+
+    def drain(self) -> None:
+        """Epoch/teardown barrier: wait out every in-flight step (so epoch
+        wall-clock logs and checkpoints see completed state)."""
+        if self._fifo:
+            _profiler.incr_counter("loop_window_drain")
+            jax.block_until_ready(self._fifo)
+            self._fifo.clear()
 
 
 class Uncacheable(Exception):

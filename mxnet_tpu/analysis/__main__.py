@@ -101,7 +101,10 @@ def _cmd_lint(args) -> int:
 def _cmd_self_check(args) -> int:
     """Model-zoo nets must produce zero ERROR-level graph findings — the
     analyzer's own regression gate (a pass that starts mis-firing on known
-    -good nets fails CI here, not in user binds)."""
+    -good nets fails CI here, not in user binds) — plus the async-loop
+    counter gate: a small async ``fit()`` must do ZERO per-batch host
+    syncs and ZERO steady-state recompiles (the loop_* profiler counters
+    the fit pipeline reports, docs/architecture/async_loop.md)."""
     from . import analyze_symbol
     failed = 0
     for name in ("resnet8", "mlp", "transformer"):
@@ -116,7 +119,53 @@ def _cmd_self_check(args) -> int:
         for f in errs:
             print("  " + f.format())
         failed += bool(errs)
+    failed += _async_loop_counter_check()
     return 1 if failed else 0
+
+
+def _async_loop_counter_check() -> int:
+    """One tiny async fit(); the loop counters must show a clean pipeline:
+    0 per-batch host syncs, 0 steady-state recompiles, every batch fed by
+    the device-prefetch stage."""
+    import numpy as np
+    from .. import config, io, module, profiler, symbol
+    from ..initializer import Uniform
+
+    data = symbol.Variable("data")
+    fc = symbol.FullyConnected(data, num_hidden=8, name="fc1")
+    net = symbol.SoftmaxOutput(fc, name="softmax")
+    rng = np.random.RandomState(0)
+    it = io.NDArrayIter(rng.uniform(-1, 1, (48, 16)).astype(np.float32),
+                        rng.randint(0, 8, (48,)).astype(np.float32),
+                        batch_size=8)
+    from ..context import cpu
+    mod = module.Module(net, context=cpu())
+    # pin every loop knob: the gate asserts exact counter values, and an
+    # ambient MXNET_TPU_DEVICE_PREFETCH=0 (say) would fail the check on
+    # healthy code — the check targets the code, not the environment
+    knobs = {"MXNET_TPU_ASYNC_WINDOW": 2, "MXNET_TPU_DEVICE_PREFETCH": 2,
+             "MXNET_TPU_DEVICE_METRICS": True}
+    for k, v in knobs.items():
+        config.set(k, v)
+    try:
+        with profiler.counter_delta() as d:
+            mod.fit(it, eval_metric="acc", num_epoch=2, optimizer="sgd",
+                    initializer=Uniform(0.01),
+                    optimizer_params={"learning_rate": 0.1})
+        c = d.all()
+    finally:
+        for k in knobs:
+            config.reset(k)
+    checks = (
+        ("loop_host_sync", c.get("loop_host_sync", 0), 0),
+        ("loop_recompile", c.get("loop_recompile", 0), 0),
+        ("loop_prefetch_placed", c.get("loop_prefetch_placed", 0), 12),
+    )
+    bad = [(k, got, want) for k, got, want in checks if got != want]
+    status = "FAIL %s" % bad if bad else "ok"
+    print("%-12s %-18s async fit counters: %s" % ("async-loop", status,
+          {k: v for k, v in sorted(c.items()) if k.startswith("loop_")}))
+    return 1 if bad else 0
 
 
 def main(argv=None) -> int:

@@ -15,6 +15,15 @@ in the AST without running anything:
 * ``wall-clock`` (WARNING) — ``time.time()`` in latency/throughput math:
   wall clocks jump with NTP; deadlines and p99s must use
   ``time.monotonic()``/``perf_counter()``.
+* ``eager-loop-sync`` (WARNING) — a host sync (``asnumpy``/``asscalar``/
+  ``wait_to_read``/``block_until_ready``) lexically inside the batch loop
+  of a training/eval-loop function (``fit``, ``score``, or ``*_loop``):
+  one sync per batch serializes the whole pipeline behind host
+  round-trips — the exact regime the async fit loop eliminated
+  (docs/architecture/async_loop.md). The DEFERRED-sync pattern is not
+  flagged: syncs inside ``get``/``get_name_value``/``_sync*`` bodies (the
+  metric log-boundary fetch) and the ``InflightWindow`` flow-control
+  waits live outside loop-function bodies by construction.
 
 Intentional sites are suppressed inline with ``# mx-lint: allow(<code>)``
 (on the offending line or the enclosing ``with`` line); historical debt is
@@ -40,6 +49,12 @@ _ALLOW = re.compile(r"#\s*mx-lint:\s*allow\(([\w\s,-]+)\)")
 # attribute-call names that synchronize with the device / block the thread
 _HOST_SYNC_METHODS = {"asnumpy", "wait_to_read", "block_until_ready",
                       "device_get", "item", "result"}
+# the subset that is unambiguous in a batch loop (`.result()`/`.item()`
+# are too generic to flag outside a lock context)
+_LOOP_SYNC_METHODS = {"asnumpy", "asscalar", "wait_to_read",
+                      "block_until_ready", "device_get"}
+# training/eval-loop owners: one sync per iteration here gates steps/s
+_LOOP_FUNC = re.compile(r"^(fit|score)$|_loop$")
 # module roots whose calls dispatch device work
 _DISPATCH_ROOTS = {"jax", "jnp"}
 _DISPATCH_ARRAY_FNS = {"array", "asarray", "device_put"}
@@ -68,6 +83,7 @@ class _FileLinter(ast.NodeVisitor):
         self.report = report
         self.lock_stack: List[Tuple[str, int]] = []   # (lock name, line)
         self.func_stack: List[str] = []
+        self.loop_depth = 0
 
     # ------------------------------------------------------- suppression
     def _allowed(self, code: str, *lines: int) -> bool:
@@ -99,8 +115,10 @@ class _FileLinter(ast.NodeVisitor):
         # textually... but nested defs under `with lock:` are usually
         # callbacks invoked elsewhere — reset the lock context for them
         saved, self.lock_stack = self.lock_stack, []
+        saved_loops, self.loop_depth = self.loop_depth, 0
         self.generic_visit(node)
         self.lock_stack = saved
+        self.loop_depth = saved_loops
         self.func_stack.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
@@ -122,6 +140,18 @@ class _FileLinter(ast.NodeVisitor):
 
     visit_AsyncWith = visit_With
 
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_AsyncFor = visit_For
+    visit_While = visit_For
+
+    def _in_loop_func(self) -> bool:
+        return bool(self.loop_depth) and bool(self.func_stack) and \
+            bool(_LOOP_FUNC.search(self.func_stack[-1]))
+
     def visit_Call(self, node):
         name = _dotted(node.func)
         leaf = name.rsplit(".", 1)[-1]
@@ -134,6 +164,18 @@ class _FileLinter(ast.NodeVisitor):
                 "time.time() is wall-clock (jumps with NTP) — use "
                 "time.monotonic()/perf_counter() for latency/deadline "
                 "math", line)
+
+        if self._in_loop_func() and (
+                leaf in _LOOP_SYNC_METHODS or name in (
+                    "jax.block_until_ready", "jax.device_get")):
+            self._add(
+                "eager-loop-sync", Severity.WARNING,
+                "host sync %r inside the batch loop of %r — one device "
+                "round-trip per batch gates steps/s; accumulate on "
+                "device and defer the fetch to a log boundary "
+                "(EvalMetric.update_device / InflightWindow, "
+                "docs/architecture/async_loop.md)"
+                % (name + "()", self.func_stack[-1]), line)
 
         if self.lock_stack:
             locks = ", ".join(l for l, _ in self.lock_stack)

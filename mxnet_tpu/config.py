@@ -128,6 +128,19 @@ register("MXNET_TPU_ANALYZE", _parse_analyze_mode, "off",
          "off = analyzer never imported (zero cost), warn = log "
          "WARNING+ findings, strict = raise MXNetError on ERROR "
          "findings before any compile")
+register("MXNET_TPU_ASYNC_WINDOW", int, 2,
+         "fit(): max train steps dispatched ahead of device completion "
+         "(sliding-window sync caps in-flight work); 0 = fully "
+         "synchronous per-batch loop (the kill switch — exactly the "
+         "pre-async behavior)")
+register("MXNET_TPU_DEVICE_PREFETCH", int, 2,
+         "fit(): batches device-placed ahead of the step consuming them "
+         "(PrefetchingIter device stage, double-buffered H2D overlap); "
+         "0 = place each batch synchronously on the critical path")
+register("MXNET_TPU_DEVICE_METRICS", _parse_bool, True,
+         "EvalMetric.update_device: accumulate (sum, count) as device "
+         "reductions chained after the step, host sync deferred to "
+         "get()/log boundaries; 0 = per-batch asnumpy host path")
 register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
          "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
          "one-pass E[x^2]-E[x]^2 form — restores precision for "

@@ -246,6 +246,25 @@ class Executor:
         self._jit_fwd_bwd = fwd_bwd if in_shardings is not None \
             else jax.jit(fwd_bwd)
 
+    @property
+    def requires_sync_loop(self) -> bool:
+        """True when programs from this executor must execute synchronously
+        with the frontend (host-callback CustomOps — the PR 2 async-drain
+        deadlock). The fit loop consults this to force
+        ``MXNET_TPU_ASYNC_WINDOW=0`` behavior and skip device prefetch:
+        background jax dispatch concurrent with a callback-bearing program
+        is exactly the deadlock shape."""
+        return self._sync_host_callbacks
+
+    @staticmethod
+    def _forced_sync(values) -> None:
+        """Block on ``values`` because the program carries host callbacks —
+        the one sync the async loop can never remove, counted so tests and
+        the analysis self-check can see it (``loop_forced_sync``)."""
+        from . import profiler as _profiler
+        _profiler.incr_counter("loop_forced_sync")
+        jax.block_until_ready(values)
+
     # ------------------------------------------------------------ placement
     def _node_device_fn(self):
         """Node -> jax device from its ctx_group (None without group2ctx)."""
@@ -320,7 +339,7 @@ class Executor:
             outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
                                           bool(is_train))
             if self._sync_host_callbacks:
-                jax.block_until_ready(outs)
+                self._forced_sync(outs)
             self._commit(outs, new_aux)
             self._pending = None
         return self.outputs
@@ -354,7 +373,7 @@ class Executor:
             outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
                                                      key, heads)
         if self._sync_host_callbacks:
-            jax.block_until_ready((outs, grads))
+            self._forced_sync((outs, grads))
         self._commit(outs, new_aux)
         self._pending = None
         for n, g in grads.items():
@@ -390,7 +409,7 @@ class Executor:
             arg_vals, aux_vals, key = self._pending
             outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key, True)
             if self._sync_host_callbacks:
-                jax.block_until_ready(outs)
+                self._forced_sync(outs)
             self._commit(outs, new_aux)
         if self._outputs is None:
             raise MXNetError("no forward has been run")

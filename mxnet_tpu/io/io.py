@@ -15,6 +15,7 @@ import os
 import queue
 import struct
 import threading
+import time
 from collections import namedtuple
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -23,6 +24,7 @@ import numpy as np
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from ..base import MXNetError
+from .. import profiler as _profiler
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter"]
@@ -263,10 +265,29 @@ class ResizeIter(DataIter):
 class PrefetchingIter(DataIter):
     """Double-buffered prefetch over one or more iterators via background
     threads (reference: io.py:340 PrefetchingIter ≡ dmlc::ThreadedIter,
-    src/io/iter_prefetcher.h:46-147)."""
+    src/io/iter_prefetcher.h:46-147).
+
+    Concurrency contract (docs/architecture/async_loop.md):
+
+    * Every queue entry is tagged with the epoch counter at the moment the
+      worker *started* reading it; ``reset()`` bumps the counter under the
+      per-iterator lock, so a batch a worker was holding across a reset
+      (mid-``put`` on a full queue — the old reset race) carries a stale
+      tag and is discarded by the consumer instead of leaking into the
+      next epoch.
+    * ``close()`` stops the workers and joins them — iterators are no
+      longer daemon-fire-and-forget; ``fit()`` closes the wrapper it
+      creates, and ``__del__`` is only the last-resort cleanup.
+    * ``device_placer`` adds a device-prefetch stage: a dedicated thread
+      issues the H2D placement (``jax.device_put`` honoring the module's
+      input shardings) for the NEXT batch while the current step computes,
+      double-buffered to ``device_prefetch`` depth
+      (``MXNET_TPU_DEVICE_PREFETCH``).
+    """
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth: int = 2):
+                 prefetch_depth: int = 2, device_placer=None,
+                 device_prefetch: Optional[int] = None):
         super().__init__()
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
@@ -278,28 +299,115 @@ class PrefetchingIter(DataIter):
         self.batch_size = self.provide_data[0].shape[0]
         self._queues = [queue.Queue(maxsize=prefetch_depth)
                         for _ in range(self.n_iter)]
+        self._epoch = 0
+        self._iter_locks = [threading.Lock() for _ in range(self.n_iter)]
+        self._closed = False
         self._started = True
+        self._first_fetch = True
+        self._device_placer = device_placer
+        if device_placer is not None:
+            # the placement runs inside the (single) worker thread rather
+            # than a separate stage: one thread and one queue hop keeps
+            # scheduling latency down on small hosts, and the H2D copy
+            # still overlaps the consumer's compute
+            assert self.n_iter == 1, \
+                "device prefetch supports a single wrapped iterator"
+            # the device path hands the inner iterator's batch through
+            # verbatim (no merge/rewrap), so renames would silently not
+            # apply to the yielded batches
+            assert rename_data is None and rename_label is None, \
+                "device prefetch does not support rename_data/rename_label"
+            if device_prefetch is None:
+                from .. import config as _config
+                device_prefetch = _config.get("MXNET_TPU_DEVICE_PREFETCH")
+            self._queues = [queue.Queue(maxsize=max(1, device_prefetch))]
         self._threads = []
         for i in range(self.n_iter):
             t = threading.Thread(target=self._worker, args=(i,), daemon=True)
             t.start()
             self._threads.append(t)
-        self._reset_events = [threading.Event() for _ in range(self.n_iter)]
+
+    # -------------------------------------------------------- stage threads
+    def _put_tagged(self, q, entry):
+        """Blocking put that abandons ship on close and lets reset-stale
+        entries through (the consumer discards them by tag)."""
+        while self._started:
+            try:
+                q.put(entry, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _worker(self, i):
         while self._started:
-            try:
-                batch = self.iters[i].next()
-                self._queues[i].put(("data", batch))
-            except StopIteration:
-                self._queues[i].put(("stop", None))
-                # wait for reset signal
-                while self._started:
-                    if getattr(self, "_reset_events", None) and \
-                            self._reset_events[i].wait(timeout=0.05):
-                        self._reset_events[i].clear()
-                        break
+            with self._iter_locks[i]:
+                # the tag is read under the same lock reset() bumps it
+                # under, so a reset can never interleave with next()
+                epoch = self._epoch
+                try:
+                    entry = (epoch, "data", self.iters[i].next())
+                except StopIteration:
+                    entry = (epoch, "stop", None)
+                except Exception as exc:               # noqa: BLE001
+                    # a dead worker would hang the consumer's blocking
+                    # get() forever — carry the error across instead,
+                    # re-raised in the thread that can actually catch it
+                    entry = (epoch, "error", exc)
+            if entry[1] == "data" and self._device_placer is not None \
+                    and epoch == self._epoch:
+                # device-prefetch stage: issue the H2D placement here so
+                # the copy overlaps the consumer's current step
+                try:
+                    entry = (epoch, "data",
+                             self._device_placer(entry[2]))
+                    _profiler.incr_counter("loop_prefetch_placed")
+                except Exception as exc:               # noqa: BLE001
+                    entry = (epoch, "error", exc)
+            self._put_tagged(self._queues[i], entry)
+            if entry[1] in ("stop", "error"):
+                # parked (end-of-epoch or failed) until reset() bumps the
+                # tag — a raising iterator must not be re-driven
+                while self._started and self._epoch == epoch:
+                    time.sleep(0.01)
 
+    @staticmethod
+    def _reraise_worker_error(exc):
+        """Re-raise an exception carried over from a prefetch worker, with
+        a breadcrumb: the traceback points into the worker thread, which
+        surprises users whose iterator fit() auto-wrapped."""
+        if hasattr(exc, "add_note"):                       # Python >= 3.11
+            exc.add_note(
+                "(raised inside a PrefetchingIter worker thread — the "
+                "inner iterator's next() runs off the main thread under "
+                "device prefetch; set MXNET_TPU_DEVICE_PREFETCH=0 for "
+                "thread-affine iterators)")
+        raise exc
+
+    def _host_next_tagged(self):
+        """One merged host batch off the worker queues, tag-preserving.
+        Entries from before the last reset are dropped here."""
+        cur = self._epoch
+        batches = []
+        for q in self._queues:
+            while True:
+                epoch, kind, batch = q.get()
+                if epoch != cur:
+                    continue        # pre-reset leftover: discard
+                break
+            if kind == "error":
+                self._reraise_worker_error(batch)
+            if kind == "stop":
+                return cur, None
+            batches.append(batch)
+        data = sum([b.data for b in batches], [])
+        label = sum([(b.label or []) for b in batches], [])
+        return cur, DataBatch(data=data, label=label or None,
+                              pad=batches[0].pad, index=batches[0].index,
+                              provide_data=self.provide_data,
+                              provide_label=self.provide_label)
+
+    # ------------------------------------------------------------- provides
     @property
     def provide_data(self):
         if self.rename_data is None:
@@ -318,32 +426,89 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
+    # ------------------------------------------------------------ lifecycle
     def reset(self):
-        # drain queues, reset underlying iters, wake workers
+        # bump the epoch under every iterator lock: workers are guaranteed
+        # not mid-next(), and anything they already produced (or are
+        # blocked putting) carries the old tag and gets discarded
+        for lock in self._iter_locks:
+            lock.acquire()
+        try:
+            self._epoch += 1
+            for it in self.iters:
+                it.reset()
+            # drain BEFORE releasing: a worker needs the iterator lock to
+            # produce a fresh-epoch batch, so everything in the queues here
+            # is stale by construction — draining after release could
+            # discard a new epoch's batch 0 (already consumed from the
+            # inner iterator = silent data loss). A worker mid-put with a
+            # stale batch lands after the drain; the consumer's tag check
+            # discards it.
+            self._drain()
+            self._first_fetch = True
+        finally:
+            for lock in self._iter_locks:
+                lock.release()
+
+    def _drain(self):
         for q in self._queues:
             while True:
                 try:
                     q.get_nowait()
                 except queue.Empty:
                     break
-        for it in self.iters:
-            it.reset()
-        for e in self._reset_events:
-            e.set()
+
+    def close(self, join_timeout=10.0):
+        """Stop and join the prefetch threads (idempotent). After close the
+        iterator is dead — create a new one to iterate again. Returns True
+        when every worker joined inside `join_timeout` seconds; False means
+        a worker is still wedged inside the inner iterator's next() and the
+        inner iterator must not be touched from another thread."""
+        if self._closed:
+            return all(not t.is_alive() for t in self._threads)
+        self._closed = True
+        self._started = False
+        deadline = time.monotonic() + join_timeout
+        for t in self._threads:
+            # workers blocked on a full queue poll _started with a 50ms
+            # timeout; drain anyway so they exit on the fast path
+            while t.is_alive() and time.monotonic() < deadline:
+                self._drain()
+                t.join(timeout=0.05)
+        self._drain()
+        return all(not t.is_alive() for t in self._threads)
 
     def next(self):
-        batches = []
-        for q in self._queues:
-            kind, batch = q.get()
+        if self._closed:
+            # the workers are gone and nothing will ever be queued again:
+            # a blocking get() here would hang forever, silently
+            raise MXNetError("PrefetchingIter used after close()")
+        if self._device_placer is not None:
+            q = self._queues[0]
+            try:
+                entry = q.get_nowait()
+            except queue.Empty:
+                # the step outran the placement stage: pipeline bubble —
+                # except on the first fetch of an epoch, where the queue
+                # is cold by construction and an empty queue says nothing
+                # about steady-state health
+                if not self._first_fetch:
+                    _profiler.incr_counter("loop_prefetch_stall")
+                entry = q.get()
+            self._first_fetch = False
+            while entry[0] != self._epoch:
+                entry = q.get()
+            _profiler.set_gauge("loop_prefetch_depth", q.qsize())
+            _epoch, kind, batch = entry
             if kind == "stop":
                 raise StopIteration
-            batches.append(batch)
-        data = sum([b.data for b in batches], [])
-        label = sum([(b.label or []) for b in batches], [])
-        return DataBatch(data=data, label=label or None,
-                         pad=batches[0].pad, index=batches[0].index,
-                         provide_data=self.provide_data,
-                         provide_label=self.provide_label)
+            if kind == "error":
+                self._reraise_worker_error(batch)
+            return batch
+        _epoch, batch = self._host_next_tagged()
+        if batch is None:
+            raise StopIteration
+        return batch
 
     def iter_next(self):
         try:
@@ -353,9 +518,12 @@ class PrefetchingIter(DataIter):
             return False
 
     def __del__(self):
-        self._started = False
-        for e in getattr(self, "_reset_events", []):
-            e.set()
+        try:
+            # GC must never block for seconds on a wedged worker: flip the
+            # flags and drain, but don't wait on the join
+            self.close(join_timeout=0.0)
+        except Exception:                                  # noqa: BLE001
+            pass
 
 
 class CSVIter(DataIter):

@@ -4,6 +4,17 @@ Reference: ``python/mxnet/metric.py`` (1,132 LoC: registry + Accuracy:339,
 TopKAccuracy:404, F1:478, Perplexity:573, MAE/MSE/RMSE:678-795,
 CrossEntropy:854, PearsonCorrelation:923, Loss, CustomMetric:1020,
 CompositeEvalMetric:209).
+
+Device-resident accumulation (docs/architecture/async_loop.md): the
+reference's ``update`` pulls every prediction to the host (``asnumpy`` — a
+full device sync per batch), which serializes the training pipeline behind
+host round-trips. Metrics that decompose into ``(sum, count)`` pairs
+additionally implement ``_device_reduce``: ``update_device`` then chains
+ONE tiny jitted reduction after the train step, accumulating into device
+scalars, and the host sync is deferred to ``get()`` — the Speedometer /
+epoch-end log boundary. Metrics that cannot (``CustomMetric``, ``F1``,
+mixed ``CompositeEvalMetric``) report ``device_capable() == False`` and the
+loop falls back to the per-batch host path automatically.
 """
 from __future__ import annotations
 
@@ -13,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as numpy_mod
 
 from .ndarray import NDArray
+from . import profiler as _profiler
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
@@ -20,6 +32,10 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "np", "create", "register"]
 
 _METRIC_REGISTRY: Dict[str, type] = {}
+# (metric class, statics) -> jitted device accumulate, shared across
+# instances; bounded in practice by the handful of metric configurations
+# a process uses
+_DEV_ACC_CACHE: Dict[tuple, object] = {}
 
 
 def register(klass):
@@ -62,6 +78,13 @@ def check_label_shapes(labels, preds, shape=0):
                          "predictions %s" % (label_shape, pred_shape))
 
 
+def _as_device(x):
+    """Raw jax array view of a label/pred — no transfer when it already
+    lives on device (the fit loop hands over the step's own arrays)."""
+    import jax.numpy as jnp
+    return x.data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
 class EvalMetric(object):
     """Base metric (reference: metric.py EvalMetric)."""
 
@@ -70,6 +93,7 @@ class EvalMetric(object):
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs
+        self._dev_fn = None
         self.reset()
 
     def update_dict(self, label: Dict, pred: Dict):
@@ -86,11 +110,121 @@ class EvalMetric(object):
     def update(self, labels, preds):
         raise NotImplementedError
 
+    # ------------------------------------------------- device-resident path
+    # Subclasses that decompose into (sum, count) set _device_capable and
+    # implement _device_reduce(label, pred) -> (sum, count) in jnp ops
+    # mirroring their host update arithmetic. _device_statics() must list
+    # every instance attribute the reduce reads, so the jitted accumulate
+    # can be shared across instances (fit() creates a fresh metric per
+    # call — a per-instance cache would recompile every epoch).
+    _device_capable = False
+
+    def _device_reduce(self, label, pred):
+        raise NotImplementedError
+
+    def _device_statics(self) -> tuple:
+        return ()
+
+    def device_capable(self) -> bool:
+        """True when this metric can accumulate on-device (and the
+        MXNET_TPU_DEVICE_METRICS knob is on) — queried by the fit loop
+        BEFORE updating so mixed composites fall back atomically."""
+        if not self._device_capable:
+            return False
+        from . import config as _config
+        return bool(_config.get("MXNET_TPU_DEVICE_METRICS"))
+
+    def _device_acc(self):
+        """Jitted chained accumulate: (acc_sum, acc_num, label, pred) ->
+        (acc_sum', acc_num'). One tiny device program per batch, no host
+        sync; cached per (class, statics) so every same-configured
+        instance shares one compiled accumulate."""
+        if self._dev_fn is None:
+            key = (type(self), self._device_statics())
+            fn = _DEV_ACC_CACHE.get(key)
+            if fn is None:
+                import copy
+                import jax
+                import jax.numpy as jnp
+                # the closure must capture a SNAPSHOT, not self: the cache
+                # outlives this instance, and a later retrace (new input
+                # shape) would otherwise read the donor's *current*
+                # attributes — wrong if they drifted from the cache key
+                snap = copy.copy(self)
+
+                def acc(acc_s, acc_n, label, pred):
+                    s, n = snap._device_reduce(label, pred)
+                    # counts are integral: a float32 accumulator stops
+                    # incrementing past 2^24 samples between syncs
+                    return (acc_s + jnp.asarray(s, jnp.float32),
+                            acc_n + jnp.asarray(n, jnp.int32))
+
+                fn = jax.jit(acc)
+                _DEV_ACC_CACHE[key] = fn
+            self._dev_fn = fn
+        return self._dev_fn
+
+    def update_device(self, labels, preds) -> bool:
+        """Accumulate this batch as a device reduction chained after the
+        step. Returns False (and touches nothing) when the metric cannot —
+        the caller must then run the host ``update`` path."""
+        if not self.device_capable():
+            return False
+        if labels is not None and not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        if self._dev_acc_state is None:
+            import jax.numpy as jnp
+            self._dev_acc_state = (jnp.zeros((), jnp.float32),
+                                   jnp.zeros((), jnp.int32))
+        acc_s, acc_n = self._dev_acc_state
+        fn = self._device_acc()
+        try:
+            for label, pred in zip(labels, preds):
+                acc_s, acc_n = fn(acc_s, acc_n, _as_device(label),
+                                  _as_device(pred))
+        except Exception:                                  # noqa: BLE001
+            # trace-time refusal (shape/dtype this reduce can't express):
+            # nothing was committed — the host path runs instead and
+            # raises its own (clearer) error if the batch is truly bad
+            return False
+        self._dev_acc_state = (acc_s, acc_n)
+        return True
+
+    def update_dict_device(self, label: Dict, pred: Dict) -> bool:
+        """``update_dict`` twin for the device path; same name selection."""
+        if not self.device_capable():
+            return False
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        return self.update_device(label, pred)
+
+    def _sync_device(self):
+        """Fold the device accumulators into the host totals — THE deferred
+        sync point (one per get()/log boundary, counted)."""
+        if self._dev_acc_state is None:
+            return
+        acc_s, acc_n = self._dev_acc_state
+        self._dev_acc_state = None
+        _profiler.incr_counter("loop_metric_sync")
+        self.sum_metric += float(acc_s)
+        self.num_inst += int(acc_n)
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_acc_state = None
 
     def get(self):
+        self._sync_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -126,6 +260,26 @@ class CompositeEvalMetric(EvalMetric):
         for metric in self.metrics:
             metric.update(labels, preds)
 
+    def device_capable(self) -> bool:
+        """A composite is device-capable only when EVERY child is — a mixed
+        set falls back to the host path as one unit, so children never see
+        a batch twice."""
+        return bool(self.metrics) and \
+            all(m.device_capable() for m in self.metrics)
+
+    def update_device(self, labels, preds) -> bool:
+        if not self.device_capable():
+            return False
+        for metric in self.metrics:
+            if not metric.update_device(labels, preds):
+                # a child refused mid-flight (shape it can't reduce):
+                # keep totals consistent by host-updating it — a REAL
+                # per-batch device round-trip, so count it where the fit
+                # loop can't see it (update_device returned True)
+                _profiler.incr_counter("loop_host_sync")
+                metric.update(labels, preds)
+        return True
+
     def reset(self):
         for metric in getattr(self, "metrics", []):
             metric.reset()
@@ -142,6 +296,8 @@ class CompositeEvalMetric(EvalMetric):
 @register
 class Accuracy(EvalMetric):
     """(reference: metric.py:339). axis: class axis of predictions."""
+
+    _device_capable = True
 
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
@@ -160,10 +316,24 @@ class Accuracy(EvalMetric):
             self.sum_metric += (pred == label).sum()
             self.num_inst += len(label)
 
+    def _device_reduce(self, label, pred):
+        import jax.numpy as jnp
+        if pred.ndim > label.ndim:
+            pred = jnp.argmax(pred, axis=self.axis)
+        pred = pred.astype(jnp.int32).ravel()
+        label = label.astype(jnp.int32).ravel()
+        check_label_shapes(label, pred, shape=1)
+        return (pred == label).sum(), label.size
+
+    def _device_statics(self):
+        return (self.axis,)
+
 
 @register
 class TopKAccuracy(EvalMetric):
     """(reference: metric.py:404)."""
+
+    _device_capable = True
 
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
@@ -177,15 +347,34 @@ class TopKAccuracy(EvalMetric):
         for label, pred in zip(labels, preds):
             label, pred = _as_np(label), _as_np(pred)
             assert pred.ndim == 2, "Predictions should be 2 dims"
-            pred = numpy_mod.argsort(pred.astype(numpy_mod.float32), axis=1)
+            # stable sort: jnp.argsort (the device reduce) is stable, and
+            # numpy's default introsort breaks ties differently — tied
+            # scores would then make host and device top-k disagree
+            pred = numpy_mod.argsort(pred.astype(numpy_mod.float32), axis=1,
+                                     kind="stable")
             label = label.astype(numpy_mod.int32)
             num_samples, num_classes = pred.shape
             top_k = min(num_classes, self.top_k)
-            for j in range(top_k):
-                self.sum_metric += (
-                    pred[:, num_classes - 1 - j].flatten() == label.flatten()
-                ).sum()
+            # one membership test over the top_k highest-score columns
+            # (argsort ascending, so the last top_k) — a label matches at
+            # most one distinct column, identical to the per-column loop
+            top = pred[:, num_classes - top_k:]
+            self.sum_metric += (
+                top == label.reshape(-1, 1)).sum()
             self.num_inst += num_samples
+
+    def _device_reduce(self, label, pred):
+        import jax.numpy as jnp
+        assert pred.ndim == 2, "Predictions should be 2 dims"
+        order = jnp.argsort(pred.astype(jnp.float32), axis=1)
+        label = label.astype(jnp.int32).reshape(-1, 1)
+        num_samples, num_classes = order.shape
+        top_k = min(num_classes, self.top_k)
+        hits = (order[:, num_classes - top_k:] == label).sum()
+        return hits, num_samples
+
+    def _device_statics(self):
+        return (self.top_k,)
 
 
 @register
@@ -222,11 +411,31 @@ class F1(EvalMetric):
 class Perplexity(EvalMetric):
     """(reference: metric.py:573)."""
 
+    _device_capable = True
+
     def __init__(self, ignore_label=None, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
         self.ignore_label = ignore_label
         self.axis = axis
+
+    def _device_reduce(self, label, pred):
+        import jax.numpy as jnp
+        assert label.size == pred.size / pred.shape[-1], \
+            "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+        label = label.reshape(-1).astype(jnp.int32)
+        probs = jnp.take_along_axis(
+            pred.reshape(-1, pred.shape[-1]), label[:, None], axis=1)[:, 0]
+        num = label.size
+        if self.ignore_label is not None:
+            ignore = (label == self.ignore_label)
+            probs = jnp.where(ignore, 1.0, probs)
+            num = num - ignore.sum()
+        loss = -jnp.sum(jnp.log(jnp.maximum(1e-10, probs)))
+        return loss, num
+
+    def _device_statics(self):
+        return (self.ignore_label, self.axis)
 
     def update(self, labels, preds):
         assert len(labels) == len(preds)
@@ -248,6 +457,7 @@ class Perplexity(EvalMetric):
         self.num_inst += num
 
     def get(self):
+        self._sync_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))
@@ -256,6 +466,8 @@ class Perplexity(EvalMetric):
 @register
 class MAE(EvalMetric):
     """(reference: metric.py:678)."""
+
+    _device_capable = True
 
     def __init__(self, name="mae", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -269,10 +481,18 @@ class MAE(EvalMetric):
             self.sum_metric += numpy_mod.abs(label - pred).mean()
             self.num_inst += 1
 
+    def _device_reduce(self, label, pred):
+        import jax.numpy as jnp
+        if label.ndim == 1:
+            label = label.reshape(label.shape[0], 1)
+        return jnp.abs(label - pred).mean(), 1
+
 
 @register
 class MSE(EvalMetric):
     """(reference: metric.py:717)."""
+
+    _device_capable = True
 
     def __init__(self, name="mse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -286,10 +506,18 @@ class MSE(EvalMetric):
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
 
+    def _device_reduce(self, label, pred):
+        import jax.numpy as jnp
+        if label.ndim == 1:
+            label = label.reshape(label.shape[0], 1)
+        return ((label - pred) ** 2.0).mean(), 1
+
 
 @register
 class RMSE(EvalMetric):
     """(reference: metric.py:756)."""
+
+    _device_capable = True
 
     def __init__(self, name="rmse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -303,10 +531,18 @@ class RMSE(EvalMetric):
             self.sum_metric += numpy_mod.sqrt(((label - pred) ** 2.0).mean())
             self.num_inst += 1
 
+    def _device_reduce(self, label, pred):
+        import jax.numpy as jnp
+        if label.ndim == 1:
+            label = label.reshape(label.shape[0], 1)
+        return jnp.sqrt(((label - pred) ** 2.0).mean()), 1
+
 
 @register
 class CrossEntropy(EvalMetric):
     """(reference: metric.py:854)."""
+
+    _device_capable = True
 
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
@@ -322,6 +558,16 @@ class CrossEntropy(EvalMetric):
             prob = pred[numpy_mod.arange(label.shape[0]), numpy_mod.int64(label)]
             self.sum_metric += (-numpy_mod.log(prob + self.eps)).sum()
             self.num_inst += label.shape[0]
+
+    def _device_reduce(self, label, pred):
+        import jax.numpy as jnp
+        label = label.ravel().astype(jnp.int32)
+        assert label.shape[0] == pred.shape[0]
+        prob = jnp.take_along_axis(pred, label[:, None], axis=1)[:, 0]
+        return (-jnp.log(prob + self.eps)).sum(), label.shape[0]
+
+    def _device_statics(self):
+        return (self.eps,)
 
 
 @register
@@ -344,6 +590,8 @@ class PearsonCorrelation(EvalMetric):
 class Loss(EvalMetric):
     """Mean of the raw outputs — for loss symbols (reference: metric.py Loss)."""
 
+    _device_capable = True
+
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
@@ -352,6 +600,18 @@ class Loss(EvalMetric):
             pred = _as_np(pred)
             self.sum_metric += pred.sum()
             self.num_inst += pred.size
+
+    def _device_reduce(self, label, pred):
+        return pred.sum(), pred.size
+
+    def update_device(self, labels, preds) -> bool:
+        # labels are ignored (and may be absent/mismatched) — feed the
+        # preds through the base accumulator with dummy labels
+        if not self.device_capable():
+            return False
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        return super().update_device(list(preds), list(preds))
 
 
 @register

@@ -16,6 +16,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import metric as _metric
 from .. import ndarray as nd
+from .. import profiler as _profiler
 from ..model import BatchEndParam
 
 __all__ = ["BaseModule"]
@@ -174,12 +175,18 @@ class BaseModule(object):
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
         eval_metric.reset()
+        update_device = getattr(self, "_update_metric_device", None)
         actual_num_batch = 0
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
+            # device-resident accumulation when the metric supports it:
+            # the eval loop then never syncs per batch either (the host
+            # fetch happens once, in get_name_value below)
+            if update_device is None or \
+                    not update_device(eval_metric, eval_batch.label):
+                self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
                 params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                        eval_metric=eval_metric, locals=locals())
@@ -252,9 +259,21 @@ class BaseModule(object):
         On TPU the per-batch body runs as one fused jitted step when the
         subclass provides ``_fit_step`` (Module does); otherwise it falls
         back to forward_backward + update.
+
+        Async pipeline (docs/architecture/async_loop.md): with
+        ``MXNET_TPU_ASYNC_WINDOW > 0`` and an async-capable module the hot
+        loop dispatches up to K steps ahead (sliding-window sync), metrics
+        accumulate as device reductions with the host fetch deferred to
+        log boundaries, and batches are device-placed by a background
+        prefetch stage — so steady state does ZERO per-batch host syncs
+        (counter-asserted: ``loop_host_sync``). A monitor, a host-callback
+        CustomOp program, or ``MXNET_TPU_ASYNC_WINDOW=0`` falls back to
+        the fully synchronous per-batch loop.
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
+        from .. import config as _config
+        from .. import _fused as _fused_mod
         if initializer is None:
             initializer = Uniform(0.01)
 
@@ -276,61 +295,135 @@ class BaseModule(object):
 
         fused = getattr(self, "_fit_step", None)
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.perf_counter()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                if fused is not None and monitor is None:
-                    fused(data_batch)
-                else:
-                    self.forward_backward(data_batch)
-                    self.update()
-                # metric BEFORE prepare: prepare may switch the current
-                # bucket module, whose outputs are not this batch's
-                self.update_metric(eval_metric, data_batch.label)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+        # ------------------------------------------------ async loop setup
+        window = int(_config.get("MXNET_TPU_ASYNC_WINDOW"))
+        async_ok = getattr(self, "_async_capable", lambda: False)
+        if monitor is not None or fused is None or not async_ok():
+            # a monitor taps per-op values (needs the sync loop); modules
+            # without a fused step, or with host-callback programs, must
+            # stay synchronous (executor.requires_sync_loop)
+            window = 0
+        update_device = getattr(self, "_update_metric_device", None)
+        inflight = _fused_mod.InflightWindow(window)
+        step_token = getattr(self, "_step_token", lambda: None)
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.perf_counter()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+        wrapped = None
+        inner_train_data = train_data
+        if window > 0:
+            depth = int(_config.get("MXNET_TPU_DEVICE_PREFETCH"))
+            placer = getattr(self, "_device_placer", lambda: None)()
+            if depth > 0 and placer is not None \
+                    and hasattr(train_data, "next") \
+                    and getattr(train_data, "provide_data", None):
+                from ..io.io import PrefetchingIter
+                if not isinstance(train_data, PrefetchingIter):
+                    train_data = wrapped = PrefetchingIter(
+                        train_data, device_placer=placer,
+                        device_prefetch=depth)
+                # an iterator the user already wrapped is used as-is:
+                # stacking a second PrefetchingIter would add a worker
+                # thread and a queue hop just for the placement stage —
+                # those batches are placed in _load_batch instead
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+        completed = False
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.perf_counter()
+                eval_metric.reset()
+                nbatch = 0
+                data_iter = iter(train_data)
+                end_of_batch = False
+                next_data_batch = next(data_iter)
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    if fused is not None and monitor is None:
+                        fused(data_batch)
+                    else:
+                        self.forward_backward(data_batch)
+                        self.update()
+                    if window > 0:
+                        inflight.push(step_token())
+                    # metric BEFORE prepare: prepare may switch the current
+                    # bucket module, whose outputs are not this batch's
+                    if window > 0 and update_device is not None and \
+                            update_device(eval_metric, data_batch.label):
+                        pass    # chained device reduction, no host sync
+                    else:
+                        if window > 0:
+                            # the async loop had to sync for this metric:
+                            # visible per-batch pipeline break
+                            _profiler.incr_counter("loop_host_sync")
+                        self.update_metric(eval_metric, data_batch.label)
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch)
+                    except StopIteration:
+                        end_of_batch = True
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(epoch=epoch,
+                                                         nbatch=nbatch,
+                                                         eval_metric=eval_metric,
+                                                         locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
+                    nbatch += 1
 
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                # epoch barrier: wait out in-flight steps so the epoch
+                # time is honest and checkpoints/eval see final state
+                inflight.drain()
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                toc = time.perf_counter()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
 
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                arg_params_, aux_params_ = self.get_params()
+                self.set_params(arg_params_, aux_params_)
 
-            train_data.reset()
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params_, aux_params_)
+
+                if eval_data is not None:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+
+                # after the FINAL epoch a wrapped iterator must not be
+                # reset here: the parked prefetch worker would wake and
+                # device-place batches of an epoch that never runs
+                # (inflating loop_prefetch_placed past one-per-consumed-
+                # batch); close() below stops it, then the inner iterator
+                # is reset exactly as the synchronous loop would leave it
+                if wrapped is None or epoch < num_epoch - 1:
+                    train_data.reset()
+            completed = True
+        finally:
+            if wrapped is not None:
+                joined = wrapped.close()
+                # leave the user's iterator exactly as the synchronous
+                # loop would: freshly reset (the prefetch workers may
+                # have pre-pulled batches past the last epoch's reset) —
+                # but only if the workers actually exited (resetting an
+                # iterator a wedged worker is still inside is a data
+                # race) and fit is not unwinding an exception (the sync
+                # loop leaves the iterator un-reset then, and a reset
+                # raising on the same broken source would mask the
+                # original error)
+                if joined and completed:
+                    inner_train_data.reset()
+                elif not joined:
+                    self.logger.warning(
+                        "prefetch worker did not exit within the close() "
+                        "deadline; skipping the final reset of the "
+                        "training iterator")
 
     def prepare(self, data_batch):
         """Prepare the module for processing a data batch (no-op by default;

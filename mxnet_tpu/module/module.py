@@ -31,6 +31,7 @@ from ..initializer import InitDesc
 from ..model import _create_kvstore, load_checkpoint, save_checkpoint
 from .. import config as _config
 from .. import _fused
+from .. import profiler as _profiler
 from .base_module import BaseModule, _check_input_names
 from ..io.io import DataDesc
 
@@ -444,8 +445,24 @@ class Module(BaseModule):
             payload = None
         if isinstance(payload, dict) and "fused" in payload \
                 and self._fused is not None:
-            self._fused_states = jax.tree_util.tree_map(
-                jnp.asarray, payload["fused"])
+            # commit each leaf onto its parameter's sharding — an
+            # uncommitted jnp.asarray would lower the fused step under a
+            # new key (one spurious recompile on the next fit step)
+            def _place_state(n, s):
+                bound = self._exec.arg_dict.get(n)
+
+                def _leaf(x):
+                    if x is None:
+                        return None
+                    x = jnp.asarray(x)
+                    return x if bound is None else \
+                        jax.device_put(x, bound.data.sharding)
+
+                return jax.tree_util.tree_map(_leaf, s,
+                                              is_leaf=lambda x: x is None)
+
+            self._fused_states = {n: _place_state(n, s)
+                                  for n, s in payload["fused"].items()}
             self._fused_num_update = payload["num_update"]
             self._optimizer.num_update = payload["num_update"]
         elif self._update_on_kvstore and self._kvstore is not None:
@@ -486,14 +503,26 @@ class Module(BaseModule):
         name2idx = {n: i for i, n in enumerate(self._param_names)}
 
         # optimizer states are created eagerly (concrete zeros) and then
-        # threaded through the jitted step as a pytree
+        # threaded through the jitted step as a pytree; each leaf is
+        # committed onto its parameter's sharding — a fresh uncommitted
+        # zeros array lowers under a different key than the committed
+        # array the jit returns, which costs one spurious recompile (and
+        # an unusable donation) on step 2
         def make_states():
             states = {}
             for n in param_names:
                 s = optimizer.create_state(name2idx[n],
                                            self._exec.arg_dict[n])
+                sharding = self._exec.arg_dict[n].data.sharding
+
+                def _place(x, _sh=sharding):
+                    if x is None:
+                        return None
+                    x = x.data if isinstance(x, nd.NDArray) else x
+                    return jax.device_put(x, _sh)
+
                 states[n] = jax.tree_util.tree_map(
-                    lambda x: x.data if isinstance(x, nd.NDArray) else x, s,
+                    _place, s,
                     is_leaf=lambda x: isinstance(x, nd.NDArray) or x is None)
             return states
 
@@ -536,17 +565,8 @@ class Module(BaseModule):
                 new_states[n] = s
             return outs, new_params, new_states, new_aux
 
-        if self._mesh is not None:
-            # pin updated params to their declared shardings — otherwise
-            # GSPMD may pick a different output layout after the first
-            # step and the user-declared tp partitioning drifts
-            param_sh = {n: self._sharding_for(n) for n in param_names}
-            self._fused_jit = jax.jit(
-                step, donate_argnums=(0, 1, 2),
-                out_shardings=(None, param_sh, None, None))
-        else:
-            self._fused_jit = jax.jit(step, donate_argnums=(0, 1, 2))
         self._fused_num_update = self._optimizer.num_update
+        self._fused_compiles = 0
 
         def run(data_batch):
             ex = self._exec
@@ -571,11 +591,22 @@ class Module(BaseModule):
             outs, new_params, new_states, new_aux = self._fused_jit(
                 params, states, aux, inputs, frozen_vals, key,
                 jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.int32))
+            cache_size = getattr(self._fused_jit, "_cache_size", None)
+            if cache_size is not None:
+                # steady-state recompiles are a bug the async tests assert
+                # against; count executable-cache growth past the warmup
+                # compile (shape churn, accidental static arg drift)
+                n = cache_size()
+                if n > self._fused_compiles:
+                    if self._fused_compiles > 0:
+                        _profiler.incr_counter("loop_recompile",
+                                               n - self._fused_compiles)
+                    self._fused_compiles = n
             if ex._sync_host_callbacks:
                 # callback-bearing program: execute synchronously with
                 # the frontend (see executor.py / operator.py — the
                 # async-drain deadlock)
-                jax.block_until_ready(outs)
+                ex._forced_sync(outs)
             for n in param_names:
                 ex.arg_dict[n]._data = new_params[n]
                 ex.arg_dict[n]._version += 1
@@ -591,6 +622,24 @@ class Module(BaseModule):
         if getattr(self, "_fused_states", None) is None or \
                 set(self._fused_states) != set(param_names):
             self._fused_states = make_states()
+        if self._mesh is not None:
+            # pin updated params to their declared shardings — otherwise
+            # GSPMD may pick a different output layout after the first
+            # step and the user-declared tp partitioning drifts — and pin
+            # updated optimizer states to the shardings make_states placed
+            # the INPUT states on: with the inputs committed, GSPMD is
+            # free to pick a different layout for the returned state (a
+            # replicated bias's momentum whose grad arrives model-sharded,
+            # say), and a donated input cannot alias an output of a
+            # different per-device size
+            param_sh = {n: self._sharding_for(n) for n in param_names}
+            state_sh = jax.tree_util.tree_map(lambda x: x.sharding,
+                                              self._fused_states)
+            self._fused_jit = jax.jit(
+                step, donate_argnums=(0, 1, 2),
+                out_shardings=(None, param_sh, state_sh, None))
+        else:
+            self._fused_jit = jax.jit(step, donate_argnums=(0, 1, 2))
         self._fused = run
 
     def _fit_step(self, data_batch):
@@ -602,32 +651,51 @@ class Module(BaseModule):
             self._fused(data_batch)
 
     # ------------------------------------------------------------- compute
+    def _place_value(self, name, arr):
+        """One input's device placement: dtype cast + shard/replicate per
+        the bound mesh (or plain device_put). Shared by the critical-path
+        ``_load_batch`` and the background device-prefetch stage, so a
+        prefetched batch lands exactly where a synchronous one would."""
+        val = arr.data if isinstance(arr, nd.NDArray) else \
+            jnp.asarray(np.asarray(arr))
+        tgt = self._exec.arg_dict.get(name)
+        if tgt is None:
+            return None
+        if val.dtype != tgt.data.dtype:
+            val = val.astype(tgt.data.dtype)
+        if self._mesh is not None:
+            if "data" in self._mesh.axis_names:
+                from ..parallel.mesh import shard_batch
+                val = shard_batch(self._mesh, val)
+            else:
+                # pure tensor-parallel mesh: the batch is replicated
+                from ..parallel.mesh import replicate
+                val = replicate(self._mesh, val)
+        else:
+            val = jax.device_put(val, self._context[0].jax_device)
+        return val
+
     def _load_batch(self, data_batch):
         """Place batch data/labels into the bound args; with a mesh, inputs
         are batch-sharded over the `data` axis (the TPU form of
-        _load_data/_load_label slicing in executor_group.py:31-75)."""
+        _load_data/_load_label slicing in executor_group.py:31-75). Batches
+        the device-prefetch stage already placed (``_mx_placed``) are
+        swapped in without touching the device."""
         ex = self._exec
         data = data_batch.data
         labels = data_batch.label or []
+        placed = getattr(data_batch, "_mx_placed", None)
 
         def place(name, arr):
-            val = arr.data if isinstance(arr, nd.NDArray) else \
-                jnp.asarray(np.asarray(arr))
+            if placed is not None and name in placed:
+                val = placed[name]
+            else:
+                val = self._place_value(name, arr)
+                if val is None:
+                    return
             tgt = ex.arg_dict.get(name)
             if tgt is None:
                 return
-            if val.dtype != tgt.data.dtype:
-                val = val.astype(tgt.data.dtype)
-            if self._mesh is not None:
-                if "data" in self._mesh.axis_names:
-                    from ..parallel.mesh import shard_batch
-                    val = shard_batch(self._mesh, val)
-                else:
-                    # pure tensor-parallel mesh: the batch is replicated
-                    from ..parallel.mesh import replicate
-                    val = replicate(self._mesh, val)
-            else:
-                val = jax.device_put(val, self._context[0].jax_device)
             tgt._data = val
             tgt._version += 1
 
@@ -635,6 +703,66 @@ class Module(BaseModule):
             place(name, arr)
         for name, arr in zip(self._label_names, labels):
             place(name, arr)
+
+    # ----------------------------------------------------------- async loop
+    def _async_capable(self) -> bool:
+        """True when fit() may run the bounded-in-flight async loop: the
+        fused step exists and the bound program carries no host callbacks
+        (callback programs must stay synchronous — executor.py
+        requires_sync_loop, the PR 2 deadlock)."""
+        return (self._fused is not None and self._exec is not None
+                and not self._exec.requires_sync_loop)
+
+    def _step_token(self):
+        """Completion token of the last fused step (its raw output arrays)
+        for the InflightWindow; None when no fused step ran."""
+        if self._fused_out is None:
+            return None
+        return tuple(o.data for o in self._fused_out)
+
+    def _device_placer(self):
+        """Callable the PrefetchingIter device stage runs in a background
+        thread: issues the H2D placement (honoring mesh input shardings)
+        for every data/label input and stashes the placed arrays on the
+        batch; ``_load_batch`` then swaps them in with zero device work on
+        the critical path."""
+        if self._exec is None:
+            return None
+
+        def place_batch(data_batch):
+            placed = {}
+            for name, arr in zip(self._data_names, data_batch.data or []):
+                val = self._place_value(name, arr)
+                if val is not None:
+                    placed[name] = val
+            for name, arr in zip(self._label_names,
+                                 data_batch.label or []):
+                val = self._place_value(name, arr)
+                if val is not None:
+                    placed[name] = val
+            data_batch._mx_placed = placed
+            return data_batch
+
+        return place_batch
+
+    def _update_metric_device(self, eval_metric, labels) -> bool:
+        """Device-resident metric update: hand the metric the step's own
+        device arrays (labels from the bound args — already placed/sharded
+        — and the fused step's outputs) so accumulation is a chained
+        device reduction with no host sync. Returns False when the metric
+        cannot (custom/numpy metrics) and the caller must run the host
+        path."""
+        if not eval_metric.device_capable():
+            return False
+        ex = self._exec
+        label_names = self._label_names or \
+            [d.name for d in self._label_shapes]
+        label_dict = {}
+        for name, arr in zip(label_names, labels or []):
+            bound = ex.arg_dict.get(name)
+            label_dict[name] = bound.data if bound is not None else arr
+        preds = dict(zip(self._output_names, self.get_outputs()))
+        return eval_metric.update_dict_device(label_dict, preds)
 
     def forward(self, data_batch, is_train=None):
         """(reference: module.py:556)."""

@@ -28,6 +28,7 @@ __all__ = [
     "set_config", "set_state", "dump", "pause", "resume",
     "start_xla_trace", "stop_xla_trace", "record_event", "state",
     "incr_counter", "get_counter", "counters", "reset_counters",
+    "counter_delta",
     "set_gauge", "get_gauge", "gauges", "reset_gauges",
 ]
 
@@ -107,6 +108,28 @@ def counters() -> dict:
 def reset_counters() -> None:
     with _lock:
         _counters.clear()
+
+
+class counter_delta(object):
+    """Context manager snapshotting the counter table so tests and benches
+    can assert on the increments one region produced (``with
+    counter_delta() as d: ...; d.get("loop_host_sync")``) without clearing
+    the global registry under concurrent users."""
+
+    def __enter__(self):
+        self._snap = counters()
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def get(self, name: str) -> int:
+        return get_counter(name) - self._snap.get(name, 0)
+
+    def all(self) -> dict:
+        now = counters()
+        return {k: v - self._snap.get(k, 0) for k, v in now.items()
+                if v != self._snap.get(k, 0)}
 
 
 # -------------------------------------------------------------- gauges

@@ -514,6 +514,27 @@ def latency():
     assert not codes(lint_source(ok, path="t.py"), "wall-clock")
 
 
+def test_lint_eager_loop_sync():
+    """A per-batch host sync inside a fit/score/*_loop batch loop fires;
+    the same sync in a non-loop function (the deferred get()-boundary
+    fetch) stays silent."""
+    src = """
+def fit(batches, metric):
+    for batch in batches:
+        metric.log(batch.out.asnumpy())   # per-batch pipeline break
+"""
+    hits = codes(lint_source(src, path="f.py"), "eager-loop-sync")
+    assert hits and hits[0].severity == Severity.WARNING
+    assert hits[0].func == "fit"
+    # the deferred-sync pattern: same call, but in a get()-style boundary
+    ok = src.replace("def fit(", "def get(").replace(
+        "for batch in batches:\n        ", "")
+    assert not codes(lint_source(ok, path="f.py"), "eager-loop-sync")
+    # and a loop in a non-loop-owning function is not flagged either
+    other = src.replace("def fit(", "def collect(")
+    assert not codes(lint_source(other, path="f.py"), "eager-loop-sync")
+
+
 def test_lint_nested_function_resets_lock_context():
     src = """
 import threading

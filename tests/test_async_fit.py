@@ -1,0 +1,601 @@
+"""Async training loop (docs/architecture/async_loop.md): parity, counter,
+and lifecycle regression suite.
+
+The acceptance contract: async ``fit()`` (bounded in-flight dispatch +
+device-resident metrics + device prefetch) must produce *identical* metric
+values and final weights to the synchronous loop, steady state must do
+ZERO per-batch host syncs and ZERO recompiles (counter-asserted, same
+trick as the serve suite), and ``MXNET_TPU_ASYNC_WINDOW=0`` must exactly
+reproduce the pre-async behavior (the kill switch). Host-callback
+(CustomOp) programs must stay synchronous — the PR 2 deadlock rule.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config as cfg
+from mxnet_tpu import metric as mmetric
+from mxnet_tpu import profiler
+
+BATCH = 8
+NSAMP = 64
+FEAT = 16
+NCLS = 8
+EPOCHS = 3
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=12, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=NCLS, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _stem_symbol():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                           name="conv0")
+    bn = mx.sym.BatchNorm(c, name="bn0")
+    r = mx.sym.Activation(bn, act_type="relu", name="relu0")
+    p = mx.sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="pool0")
+    f = mx.sym.Flatten(p, name="flat")
+    fc = mx.sym.FullyConnected(f, num_hidden=NCLS, name="fc1")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _mlp_data():
+    rng = np.random.RandomState(0)
+    return (rng.uniform(-1, 1, (NSAMP, FEAT)).astype(np.float32),
+            rng.randint(0, NCLS, (NSAMP,)).astype(np.float32))
+
+
+def _stem_data():
+    rng = np.random.RandomState(1)
+    return (rng.uniform(-1, 1, (NSAMP, 3, 8, 8)).astype(np.float32),
+            rng.randint(0, NCLS, (NSAMP,)).astype(np.float32))
+
+
+def _seed_init(symbol, shapes):
+    """Deterministic init params so independent fit() runs are comparable
+    (fit's default initializer draws from the unseeded global RNG)."""
+    rng = np.random.RandomState(42)
+    args, _, _ = symbol.infer_shape(**shapes)
+    init = {}
+    for name, shape in zip(symbol.list_arguments(), args):
+        if name in shapes:
+            continue
+        init[name] = mx.nd.array(
+            rng.uniform(-0.1, 0.1, shape).astype(np.float32))
+    return init
+
+
+def _fit(symbol, X, Y, window, metric=None, epochs=EPOCHS, dev_metrics=True,
+         prefetch=None, lr=0.1):
+    """One deterministic fit() under the given knobs; returns (metric
+    name/value pairs of the last epoch, {param: np.ndarray}, counter
+    deltas)."""
+    shapes = {"data": (BATCH,) + X.shape[1:], "softmax_label": (BATCH,)}
+    init = _seed_init(symbol, shapes)
+    cfg.set("MXNET_TPU_ASYNC_WINDOW", window)
+    cfg.set("MXNET_TPU_DEVICE_METRICS", dev_metrics)
+    if prefetch is not None:
+        cfg.set("MXNET_TPU_DEVICE_PREFETCH", prefetch)
+    try:
+        it = mx.io.NDArrayIter(X, Y, batch_size=BATCH)
+        mod = mx.mod.Module(symbol, context=mx.cpu())
+        m = metric if metric is not None else mx.metric.Accuracy()
+        with profiler.counter_delta() as d:
+            mod.fit(it, eval_metric=m, num_epoch=epochs, optimizer="sgd",
+                    optimizer_params={"learning_rate": lr},
+                    arg_params={k: v.copy() for k, v in init.items()})
+        arg, aux = mod.get_params()
+        weights = {k: v.asnumpy().copy() for k, v in arg.items()}
+        weights.update({k: v.asnumpy().copy() for k, v in aux.items()})
+        return m.get_name_value(), weights, d.all()
+    finally:
+        for k in ("MXNET_TPU_ASYNC_WINDOW", "MXNET_TPU_DEVICE_METRICS",
+                  "MXNET_TPU_DEVICE_PREFETCH"):
+            cfg.reset(k)
+
+
+def _assert_weights_equal(w0, w1):
+    assert set(w0) == set(w1)
+    for k in w0:
+        np.testing.assert_array_equal(w0[k], w1[k], err_msg=k)
+
+
+# ---------------------------------------------------------------- parity
+def test_async_sync_parity_mlp():
+    """Bit-identical metric values and final weights, MLP, 3 epochs."""
+    X, Y = _mlp_data()
+    m0, w0, _ = _fit(_mlp_symbol(), X, Y, window=0)
+    m2, w2, _ = _fit(_mlp_symbol(), X, Y, window=2)
+    assert m0 == m2, (m0, m2)
+    _assert_weights_equal(w0, w2)
+
+
+def test_async_sync_parity_resnet_stem():
+    """Conv/BN/pool stem: parity must also cover aux (BN running stats)."""
+    X, Y = _stem_data()
+    m0, w0, _ = _fit(_stem_symbol(), X, Y, window=0)
+    m2, w2, _ = _fit(_stem_symbol(), X, Y, window=2)
+    assert m0 == m2, (m0, m2)
+    _assert_weights_equal(w0, w2)
+
+
+def test_kill_switch_window_zero_is_fully_synchronous():
+    """MXNET_TPU_ASYNC_WINDOW=0 exactly reproduces the pre-async loop: no
+    async machinery runs at all — no window waits, no prefetch placement,
+    no deferred metric sync."""
+    X, Y = _mlp_data()
+    _, _, counters = _fit(_mlp_symbol(), X, Y, window=0)
+    for k in ("loop_window_wait", "loop_window_drain",
+              "loop_prefetch_placed", "loop_metric_sync",
+              "loop_host_sync", "loop_recompile"):
+        assert counters.get(k, 0) == 0, (k, counters)
+
+
+# --------------------------------------------------------------- counters
+def test_steady_state_zero_per_batch_syncs():
+    """THE tentpole assertion: async fit does 0 per-batch host syncs and 0
+    steady-state recompiles; every batch is device-placed by the prefetch
+    stage; the metric syncs once per epoch boundary, not per batch."""
+    X, Y = _mlp_data()
+    nbatches = (NSAMP // BATCH) * EPOCHS
+    _, _, counters = _fit(_mlp_symbol(), X, Y, window=2)
+    assert counters.get("loop_host_sync", 0) == 0, counters
+    assert counters.get("loop_recompile", 0) == 0, counters
+    assert counters.get("loop_prefetch_placed", 0) == nbatches, counters
+    # one deferred metric fetch per epoch log boundary (get_name_value)
+    assert counters.get("loop_metric_sync", 0) == EPOCHS, counters
+    # the sliding window engaged: waits happen once the fifo passes depth
+    assert counters.get("loop_window_wait", 0) > 0, counters
+
+
+def test_custom_metric_falls_back_per_batch():
+    """A numpy CustomMetric cannot accumulate on device: the loop must run
+    the host path each batch and count the sync (the visible pipeline
+    break), while still producing correct values."""
+    X, Y = _mlp_data()
+
+    def top1(label, pred):
+        return float((pred.argmax(axis=1) == label).mean())
+
+    m = mx.metric.CustomMetric(top1, name="np_top1")
+    nv, _, counters = _fit(_mlp_symbol(), X, Y, window=2, metric=m)
+    nbatches = (NSAMP // BATCH) * EPOCHS
+    assert counters.get("loop_host_sync", 0) == nbatches, counters
+    assert counters.get("loop_metric_sync", 0) == 0, counters
+    assert 0.0 <= dict(nv)["np_top1"] <= 1.0
+
+
+def test_device_metrics_knob_disables_device_path():
+    X, Y = _mlp_data()
+    m0, w0, _ = _fit(_mlp_symbol(), X, Y, window=0)
+    m2, w2, counters = _fit(_mlp_symbol(), X, Y, window=2,
+                            dev_metrics=False)
+    assert counters.get("loop_metric_sync", 0) == 0
+    assert counters.get("loop_host_sync", 0) > 0
+    assert m0 == m2
+    _assert_weights_equal(w0, w2)
+
+
+def test_async_capable_false_for_host_callback_program():
+    """CustomOp (host-callback) programs must stay synchronous with the
+    frontend — the PR 2 deadlock rule: the async window never engages and
+    every step is a forced sync."""
+
+    @mx.operator.register("async_fit_scale")
+    class ScaleProp(mx.operator.CustomOpProp):  # noqa: F841
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Scale(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2.0)
+
+            return Scale()
+
+    X, Y = _mlp_data()
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=12, name="fc1")
+    sc = mx.sym.Custom(data=fc1, op_type="async_fit_scale", name="sc")
+    fc2 = mx.sym.FullyConnected(sc, num_hidden=NCLS, name="fc2")
+    sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    cfg.set("MXNET_TPU_ASYNC_WINDOW", 2)
+    try:
+        it = mx.io.NDArrayIter(X, Y, batch_size=BATCH)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        with profiler.counter_delta() as d:
+            mod.fit(it, eval_metric="acc", num_epoch=1, optimizer="sgd",
+                    initializer=mx.init.Xavier(),
+                    optimizer_params={"learning_rate": 0.1})
+        counters = d.all()
+    finally:
+        cfg.reset("MXNET_TPU_ASYNC_WINDOW")
+    assert counters.get("loop_window_wait", 0) == 0, counters
+    assert counters.get("loop_prefetch_placed", 0) == 0, counters
+    assert counters.get("loop_forced_sync", 0) >= NSAMP // BATCH, counters
+
+
+# ------------------------------------------------- device metric parity
+_DEV_METRIC_CASES = [
+    ("acc", lambda: mmetric.Accuracy(),
+     lambda rng: (rng.randint(0, 4, (16,)).astype(np.float32),
+                  rng.uniform(0, 1, (16, 4)).astype(np.float32))),
+    ("topk", lambda: mmetric.TopKAccuracy(top_k=3),
+     lambda rng: (rng.randint(0, 6, (16,)).astype(np.float32),
+                  rng.uniform(0, 1, (16, 6)).astype(np.float32))),
+    ("mse", lambda: mmetric.MSE(),
+     lambda rng: (rng.uniform(-1, 1, (16, 4)).astype(np.float32),
+                  rng.uniform(-1, 1, (16, 4)).astype(np.float32))),
+    ("mae", lambda: mmetric.MAE(),
+     lambda rng: (rng.uniform(-1, 1, (16, 4)).astype(np.float32),
+                  rng.uniform(-1, 1, (16, 4)).astype(np.float32))),
+    ("rmse", lambda: mmetric.RMSE(),
+     lambda rng: (rng.uniform(-1, 1, (16, 4)).astype(np.float32),
+                  rng.uniform(-1, 1, (16, 4)).astype(np.float32))),
+    ("ce", lambda: mmetric.CrossEntropy(),
+     lambda rng: (rng.randint(0, 4, (16,)).astype(np.float32),
+                  rng.dirichlet(np.ones(4), 16).astype(np.float32))),
+    ("ppl", lambda: mmetric.Perplexity(ignore_label=0),
+     lambda rng: (rng.randint(0, 4, (16,)).astype(np.float32),
+                  rng.dirichlet(np.ones(4), 16).astype(np.float32))),
+    ("loss", lambda: mmetric.Loss(),
+     lambda rng: (rng.uniform(0, 1, (16,)).astype(np.float32),
+                  rng.uniform(0, 2, (16,)).astype(np.float32))),
+]
+
+
+@pytest.mark.parametrize("name,make,gen",
+                         _DEV_METRIC_CASES, ids=[c[0] for c in
+                                                 _DEV_METRIC_CASES])
+def test_update_device_matches_host_update(name, make, gen):
+    """Every device-capable metric: N batches through update_device give
+    the same get() as the per-batch host path (f32 device accumulate vs
+    float64 host accumulate → tolerance, exact for the count metrics)."""
+    rng = np.random.RandomState(7)
+    batches = [gen(rng) for _ in range(4)]
+    host, dev = make(), make()
+    assert dev.device_capable()
+    for label, pred in batches:
+        host.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        assert dev.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    (hn, hv), (dn, dv) = host.get(), dev.get()
+    assert hn == dn
+    np.testing.assert_allclose(dv, hv, rtol=2e-6, atol=2e-7)
+    # get() drained the device accumulator: num_inst now lives on host
+    assert dev.num_inst == host.num_inst
+
+
+def test_update_device_interleaves_with_host_update():
+    """Mixing update() and update_device() on one instance must total
+    correctly — get() folds the device accumulator into the host sums."""
+    rng = np.random.RandomState(3)
+    label = rng.randint(0, 4, (8,)).astype(np.float32)
+    pred = rng.uniform(0, 1, (8, 4)).astype(np.float32)
+    m, ref = mmetric.Accuracy(), mmetric.Accuracy()
+    m.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert m.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    for _ in range(2):
+        ref.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert m.get() == ref.get()
+
+
+def test_composite_device_capability():
+    """All-capable composite accumulates on device as a unit; a composite
+    with one host-only child falls back atomically (no child sees a batch
+    twice)."""
+    both = mmetric.CompositeEvalMetric(
+        [mmetric.Accuracy(), mmetric.TopKAccuracy(top_k=2)])
+    assert both.device_capable()
+    mixed = mmetric.CompositeEvalMetric(
+        [mmetric.Accuracy(), mmetric.F1()])
+    assert not mixed.device_capable()
+    assert not mixed.update_device([mx.nd.zeros((4,))],
+                                   [mx.nd.zeros((4, 2))])
+    assert mixed.metrics[0].num_inst == 0  # nothing committed on refusal
+
+    rng = np.random.RandomState(5)
+    label = rng.randint(0, 3, (12,)).astype(np.float32)
+    pred = rng.uniform(0, 1, (12, 3)).astype(np.float32)
+    ref = mmetric.CompositeEvalMetric(
+        [mmetric.Accuracy(), mmetric.TopKAccuracy(top_k=2)])
+    ref.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert both.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert both.get_name_value() == ref.get_name_value()
+
+
+def test_reset_discards_device_accumulator():
+    rng = np.random.RandomState(9)
+    label = rng.randint(0, 4, (8,)).astype(np.float32)
+    pred = rng.uniform(0, 1, (8, 4)).astype(np.float32)
+    m = mmetric.Accuracy()
+    assert m.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    m.reset()
+    assert m.num_inst == 0
+    name, val = m.get()
+    assert np.isnan(val)
+
+
+# ----------------------------------------- vectorized host-path parity
+def _topk_loop_reference(label, pred, top_k):
+    """The pre-vectorization per-column loop (reference metric.py:404)."""
+    order = np.argsort(pred.astype(np.float32), axis=1)
+    label = label.astype(np.int32)
+    num_samples, num_classes = order.shape
+    k = min(num_classes, top_k)
+    hits = 0
+    for j in range(k):
+        hits += (order[:, num_classes - 1 - j].flatten()
+                 == label.flatten()).sum()
+    return hits, num_samples
+
+
+def _f1_loop_reference(label, pred):
+    """Per-sample tp/fp/fn counting (reference metric.py:478)."""
+    pred_label = np.argmax(pred, axis=1)
+    label = label.astype(np.int32).flatten()
+    tp = fp = fn = 0
+    for y_hat, y in zip(pred_label, label):
+        if y_hat == 1 and y == 1:
+            tp += 1
+        elif y_hat == 1 and y == 0:
+            fp += 1
+        elif y_hat == 0 and y == 1:
+            fn += 1
+    precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+    recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+    return 2 * precision * recall / (precision + recall) \
+        if precision + recall > 0 else 0.0
+
+
+def _pearson_loop_reference(label, pred):
+    """Explicit sum-form Pearson r over samples (reference metric.py:923)."""
+    x, y = pred.ravel(), label.ravel()
+    n = len(x)
+    mx_, my = sum(x) / n, sum(y) / n
+    num = sum((a - mx_) * (b - my) for a, b in zip(x, y))
+    den = (sum((a - mx_) ** 2 for a in x)
+           * sum((b - my) ** 2 for b in y)) ** 0.5
+    return num / den
+
+
+def test_topk_vectorized_matches_loop():
+    rng = np.random.RandomState(11)
+    for top_k in (2, 3, 5):
+        label = rng.randint(0, 5, (32,)).astype(np.float32)
+        pred = rng.uniform(0, 1, (32, 5)).astype(np.float32)
+        m = mmetric.TopKAccuracy(top_k=top_k)
+        m.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        hits, n = _topk_loop_reference(label, pred, top_k)
+        assert m.sum_metric == hits and m.num_inst == n
+
+
+def test_f1_vectorized_matches_loop():
+    rng = np.random.RandomState(13)
+    for _ in range(3):
+        label = rng.randint(0, 2, (32,)).astype(np.float32)
+        pred = rng.uniform(0, 1, (32, 2)).astype(np.float32)
+        m = mmetric.F1()
+        m.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        np.testing.assert_allclose(m.get()[1],
+                                   _f1_loop_reference(label, pred),
+                                   rtol=1e-12)
+
+
+def test_pearson_vectorized_matches_loop():
+    rng = np.random.RandomState(17)
+    label = rng.uniform(-1, 1, (32, 3)).astype(np.float32)
+    pred = (0.5 * label + 0.1 * rng.uniform(-1, 1, (32, 3))) \
+        .astype(np.float32)
+    m = mmetric.PearsonCorrelation()
+    m.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    np.testing.assert_allclose(m.get()[1],
+                               _pearson_loop_reference(label, pred),
+                               rtol=1e-6)
+
+
+# --------------------------------------------------- PrefetchingIter
+def test_user_prefetching_iter_not_double_wrapped():
+    """fit() must use an iterator the user already wrapped as-is instead
+    of stacking a second PrefetchingIter (extra worker thread + queue hop
+    just for the placement stage): no device-prefetch stage is attached
+    (batches are placed in _load_batch), and training parity holds."""
+    X, Y = _mlp_data()
+    ref_m, ref_w, _ = _fit(_mlp_symbol(), X, Y, window=2)
+    shapes = {"data": (BATCH, FEAT), "softmax_label": (BATCH,)}
+    init = _seed_init(_mlp_symbol(), shapes)
+    cfg.set("MXNET_TPU_ASYNC_WINDOW", 2)
+    try:
+        it = mx.io.PrefetchingIter(
+            mx.io.NDArrayIter(X, Y, batch_size=BATCH))
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        m = mx.metric.Accuracy()
+        with profiler.counter_delta() as d:
+            mod.fit(it, eval_metric=m, num_epoch=EPOCHS, optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.1},
+                    arg_params={k: v.copy() for k, v in init.items()})
+        assert it._device_placer is None
+        # a stacked wrapper would run the device stage: placed > 0
+        assert d.all().get("loop_prefetch_placed", 0) == 0, d.all()
+        assert m.get_name_value() == ref_m, (m.get_name_value(), ref_m)
+        arg, aux = mod.get_params()
+        weights = {k: v.asnumpy().copy() for k, v in arg.items()}
+        weights.update({k: v.asnumpy().copy() for k, v in aux.items()})
+        _assert_weights_equal(ref_w, weights)
+        assert it.close()
+    finally:
+        cfg.reset("MXNET_TPU_ASYNC_WINDOW")
+
+
+def test_prefetching_iter_close_joins_workers():
+    data = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mx.io.NDArrayIter(data, np.arange(12), batch_size=4)
+    before = threading.active_count()
+    it = mx.io.PrefetchingIter(base)
+    assert threading.active_count() > before
+    it.next()
+    it.close()
+    deadline = time.monotonic() + 5.0
+    while any(t.is_alive() for t in it._threads):
+        assert time.monotonic() < deadline, "prefetch worker leaked"
+        time.sleep(0.01)
+    it.close()  # idempotent
+
+
+def test_prefetching_iter_reset_race():
+    """Regression for the reset race: a worker holding a pre-reset batch
+    (blocked on a full queue) must not leak it into the next epoch — every
+    post-reset epoch starts at batch 0 and yields exactly n batches."""
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+    base = mx.io.NDArrayIter(data, np.arange(20), batch_size=4)
+    it = mx.io.PrefetchingIter(base, prefetch_depth=1)
+    try:
+        for trial in range(6):
+            # pull a partial epoch so workers are mid-stream, then reset
+            # at a varying depth to scan interleavings
+            for _ in range(trial % 4):
+                it.next()
+            time.sleep(0.01)   # let the worker block on the full queue
+            it.reset()
+            batches = []
+            try:
+                while True:
+                    batches.append(it.next())
+            except StopIteration:
+                pass
+            assert len(batches) == 5, "epoch leaked/lost batches"
+            np.testing.assert_array_equal(batches[0].data[0].asnumpy(),
+                                          data[:4])
+            it.reset()
+    finally:
+        it.close()
+
+
+def test_prefetching_iter_device_stage():
+    """The device-prefetch stage runs the placer in the worker thread and
+    hands the consumer already-placed batches; placement failures re-raise
+    in the consumer."""
+    data = np.arange(24, dtype=np.float32).reshape(12, 2)
+    placed_in = []
+
+    def placer(batch):
+        placed_in.append(threading.current_thread().name)
+        batch._mx_placed = {"data": batch.data[0]}
+        return batch
+
+    base = mx.io.NDArrayIter(data, np.arange(12), batch_size=4)
+    it = mx.io.PrefetchingIter(base, device_placer=placer)
+    try:
+        batches = []
+        try:
+            while True:
+                batches.append(it.next())
+        except StopIteration:
+            pass
+        assert len(batches) == 3
+        assert all(hasattr(b, "_mx_placed") for b in batches)
+        main = threading.current_thread().name
+        assert all(name != main for name in placed_in), \
+            "placement ran on the consumer thread (critical path)"
+    finally:
+        it.close()
+
+    def bad_placer(batch):
+        raise RuntimeError("H2D exploded")
+
+    base2 = mx.io.NDArrayIter(data, np.arange(12), batch_size=4)
+    it2 = mx.io.PrefetchingIter(base2, device_placer=bad_placer)
+    try:
+        with pytest.raises(RuntimeError, match="H2D exploded"):
+            for _ in range(4):
+                it2.next()
+    finally:
+        it2.close()
+
+
+def test_prefetching_iter_inner_error_reraises():
+    """A raising inner iterator must surface in the consumer, not kill the
+    worker silently and hang next() on an empty queue forever."""
+    class _Exploding(mx.io.DataIter):
+        def __init__(self):
+            super().__init__()
+            self._inner = mx.io.NDArrayIter(
+                np.zeros((12, 2), np.float32), np.arange(12), batch_size=4)
+            self.provide_data = self._inner.provide_data
+            self.provide_label = self._inner.provide_label
+            self.batch_size = 4
+            self._n = 0
+
+        def next(self):
+            self._n += 1
+            if self._n > 1:
+                raise IOError("corrupt record")
+            return self._inner.next()
+
+        def reset(self):
+            self._n = 0
+            self._inner.reset()
+
+    for placer in (None, lambda b: b):
+        it = mx.io.PrefetchingIter(_Exploding(), device_placer=placer)
+        try:
+            it.next()
+            with pytest.raises(IOError, match="corrupt record"):
+                it.next()
+        finally:
+            it.close()
+
+
+def test_fit_closes_its_prefetcher():
+    """fit() must tear down the PrefetchingIter it wraps around the user's
+    iterator (satellite: no daemon-thread leak across fits)."""
+    X, Y = _mlp_data()
+    before = threading.active_count()
+    _fit(_mlp_symbol(), X, Y, window=2, epochs=1)
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before:
+        assert time.monotonic() < deadline, "fit leaked prefetch threads"
+        time.sleep(0.01)
+
+
+# ------------------------------------------------------------ slow tier
+@pytest.mark.slow
+def test_window_depth_sweep_parity():
+    """Every window depth (including deeper-than-epoch) reproduces the
+    synchronous result exactly — the sliding window is flow control, not
+    numerics."""
+    X, Y = _mlp_data()
+    m0, w0, _ = _fit(_mlp_symbol(), X, Y, window=0)
+    for depth in (1, 2, 4, 16):
+        m, w, _ = _fit(_mlp_symbol(), X, Y, window=depth)
+        assert m == m0, (depth, m, m0)
+        _assert_weights_equal(w0, w)
+
+
+@pytest.mark.slow
+def test_donation_stress_many_epochs():
+    """Donation safety under a deep window across many epochs: params swap
+    through arg_dict every step, so no buffer is ever re-donated while an
+    in-flight step still references it (jax would raise on a donated
+    buffer reuse — surviving 10 epochs IS the assertion), and training
+    still matches the synchronous loop bit-for-bit."""
+    X, Y = _stem_data()
+    m0, w0, _ = _fit(_stem_symbol(), X, Y, window=0, epochs=10)
+    m4, w4, counters = _fit(_stem_symbol(), X, Y, window=4, epochs=10)
+    assert m0 == m4
+    _assert_weights_equal(w0, w4)
+    assert counters.get("loop_host_sync", 0) == 0
+    assert counters.get("loop_recompile", 0) == 0

@@ -1,0 +1,57 @@
+"""Tier-1 smoke for tools/perf/fit_loop_bench.py (not slow).
+
+Runs the quick variant end-to-end (real fit() epochs, sync vs async, on
+the input-bound MLP and the compute-bound stem) and asserts the
+mechanics the acceptance criteria care about: zero per-batch host syncs,
+zero steady-state recompiles, the prefetch stage placed every batch, and
+the JSON artifact schema matches what BENCH_fit_loop.json records.
+Wall-clock speedup is recorded by the full bench, not asserted here —
+shared CI hosts are too noisy for a hard ratio gate (same policy as
+test_trainer_step_bench / test_serve_bench).
+"""
+import importlib
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _load_bench():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "perf"))
+    try:
+        return importlib.import_module("fit_loop_bench")
+    finally:
+        sys.path.pop(0)
+
+
+def test_fit_loop_bench_quick(tmp_path):
+    bench = _load_bench()
+    results = bench.run(quick=True)
+    assert set(results) == {"mlp", "resnet_stem"}
+    for name, r in results.items():
+        for k in ("sync_steps_s", "async_steps_s", "speedup",
+                  "batches_per_epoch", "host_syncs_per_batch",
+                  "steady_state_recompiles", "prefetch_placed",
+                  "window_waits", "metric_syncs"):
+            assert k in r, "missing %s in %s" % (k, name)
+        assert np.isfinite(r["sync_steps_s"]) and r["sync_steps_s"] > 0
+        assert np.isfinite(r["async_steps_s"]) and r["async_steps_s"] > 0
+        # the tentpole's counter gate: async fit never syncs per batch,
+        # never recompiles after warmup, and prefetch feeds every batch
+        assert r["host_syncs_per_batch"] == 0, (name, r)
+        assert r["steady_state_recompiles"] == 0, (name, r)
+        assert r["prefetch_placed"] == r["batches_per_epoch"], (name, r)
+        assert r["metric_syncs"] == 1, (name, r)
+
+    # artifact schema: what the driver's BENCH_fit_loop.json consumers read
+    path = str(tmp_path / "BENCH_fit_loop.json")
+    with open(path, "w") as f:
+        json.dump({"bench": "fit_loop", "results": results}, f)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["bench"] == "fit_loop"
+    assert loaded["results"]["mlp"]["async_steps_s"] == \
+        results["mlp"]["async_steps_s"]
