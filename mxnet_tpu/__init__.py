@@ -70,9 +70,9 @@ def __getattr__(name):
     # import-free (tests/test_analysis.py::test_analyze_off_is_zero_cost).
     # importlib, NOT `from . import analysis`: the fromlist form re-enters
     # this __getattr__ via importlib._handle_fromlist -> infinite recursion
-    if name == "analysis":
+    if name in ("analysis", "checkpoint"):
         import importlib
-        return importlib.import_module(".analysis", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r" % (__name__, name))
 
 

@@ -284,6 +284,34 @@ def _state_sig(s):
     return aval_key(s._data)
 
 
+def _dealias_states(weights, states_raw):
+    """Break buffer aliasing between donated inputs before a fused call.
+
+    Two donated arguments must never share one buffer: XLA would either
+    reject the donation or hand the same memory to two outputs. Aliases
+    are real in this codebase — eager optimizer ``update``s may write
+    ``state._data = weight.data`` (the Test optimizer does), and a
+    ``set_states`` restore can intern identical leaves — so before a
+    donating fused step every state leaf that IS a weight buffer (or a
+    previously-seen state leaf) is replaced by a device-side copy.
+    Returns the (possibly rewritten) raw state list."""
+    import jax.numpy as jnp
+    seen = {id(w) for w in weights}
+
+    def visit(s):
+        if s is None:
+            return None
+        if isinstance(s, tuple):
+            return tuple(visit(x) for x in s)
+        if id(s) in seen:
+            _profiler.incr_counter("trainer_step_dealias_copy")
+            return jnp.copy(s)
+        seen.add(id(s))
+        return s
+
+    return [visit(s) for s in states_raw]
+
+
 def _commit_state(old, new):
     """Write updated raw values back into the EXISTING NDArray state tree
     in place, so ``Updater.states`` keeps one object identity whether steps
@@ -396,6 +424,10 @@ class FusedUpdater:
         weights = [w._data for _i, w, _g in items]
         grads = [g._data for _i, _w, g in items]
         states_raw = [_state_raw(states[idx]) for idx, _w, _g in items]
+        if jax.default_backend() != "cpu":
+            # donated inputs must not share buffers (weight-aliased state
+            # after an eager step or a set_states restore)
+            states_raw = _dealias_states(weights, states_raw)
 
         # recording off around the trace: a step() issued inside
         # autograd.record() must not spill tracer-valued update ops onto

@@ -11,7 +11,8 @@ import sys
 import time
 
 __all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
-           "log_train_metric", "ProgressBar", "BatchEndParam"]
+           "subsystem_checkpoint", "log_train_metric", "ProgressBar",
+           "BatchEndParam"]
 
 
 class BatchEndParam(object):
@@ -38,7 +39,13 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
 
 def do_checkpoint(prefix, period=1):
     """Epoch callback saving prefix-symbol.json + prefix-%04d.params
-    (reference: callback.py do_checkpoint; model.py save_checkpoint:340)."""
+    (reference: callback.py do_checkpoint; model.py save_checkpoint:340).
+
+    Rebased onto the atomic write path: both files go through
+    ``mx.checkpoint.atomic_open`` (temp + fsync + rename), so a crash
+    mid-save never tears a previously-saved epoch. This remains the
+    params-only legacy layout; for resumable training state prefer
+    ``fit(checkpoint=...)`` or :func:`subsystem_checkpoint`."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
@@ -46,6 +53,29 @@ def do_checkpoint(prefix, period=1):
             from .model import save_checkpoint
             save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
 
+    return _callback
+
+
+def subsystem_checkpoint(module, manager, period=1):
+    """Epoch callback driving the ``mx.checkpoint`` subsystem — for loops
+    composed from callbacks instead of ``fit(checkpoint=...)`` (which
+    owns scheduling, SIGTERM, and teardown itself). Each firing snapshots
+    the FULL resumable state (params + optimizer + RNG) and hands it to
+    the manager's bounded async writer; call ``manager.close()`` when
+    training ends to drain it.
+
+    ``manager`` may be a ``CheckpointManager``, a ``CheckpointConfig``,
+    or a bare directory path."""
+    from . import checkpoint as _ckpt
+    if not isinstance(manager, _ckpt.CheckpointManager):
+        manager = _ckpt.CheckpointManager(manager)
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            manager.save_module(module, epoch=iter_no)
+
+    _callback.manager = manager
     return _callback
 
 

@@ -1,0 +1,47 @@
+"""``mx.checkpoint`` — asynchronous, crash-safe checkpointing with exact
+resume (docs/architecture/checkpoint.md).
+
+What the legacy surface (``model.save_checkpoint`` + ``nd.save``) cannot
+do, this subsystem does:
+
+* **crash-safe**: checkpoints are atomic directories (temp + fsync +
+  rename) with per-array checksums — ``kill -9`` at any byte never
+  destroys the previous checkpoint, and a corrupt/torn candidate is
+  detected and skipped at load (``format.py``);
+* **asynchronous**: the device→host snapshot is decoupled from
+  serialization — the step loop blocks only for reference/copy capture
+  while a bounded background writer drains to disk (``manager.py``,
+  CheckFreq/Check-N-Run discipline; ``ckpt_block_us`` vs
+  ``ckpt_write_us`` counters);
+* **complete**: parameters, aux states, fused optimizer-state pytree,
+  update counts, epoch/batch position, both PRNG chains, and metric
+  accumulators — so ``Module.fit(resume_from=dir)`` reproduces an
+  uninterrupted run bit-identically;
+* **bounded**: keep-last-N / keep-every-K retention GC that can never
+  delete the only valid checkpoint.
+
+Typical use::
+
+    import mxnet_tpu as mx
+    cfg = mx.checkpoint.CheckpointConfig("ckpts/", every_n_batches=100)
+    mod.fit(train_iter, num_epoch=90, checkpoint=cfg)      # auto-saves
+    ...
+    mod.fit(train_iter, num_epoch=90, resume_from="ckpts/")  # exact resume
+"""
+from .atomic import atomic_open, fsync_dir, replace_and_sync
+from .format import (ARRAYS_NAME, MANIFEST_NAME, CheckpointCorrupt,
+                     CheckpointError, CheckpointNotFound,
+                     collect_garbage, list_checkpoints, load_latest,
+                     probe_valid, read_checkpoint, write_checkpoint)
+from .manager import (Checkpoint, CheckpointConfig, CheckpointManager,
+                      restore_global_rng, restore_latest)
+
+__all__ = [
+    "CheckpointConfig", "CheckpointManager", "Checkpoint",
+    "CheckpointError", "CheckpointCorrupt", "CheckpointNotFound",
+    "restore_latest", "restore_global_rng",
+    "write_checkpoint", "read_checkpoint", "load_latest",
+    "list_checkpoints", "probe_valid", "collect_garbage",
+    "atomic_open", "fsync_dir", "replace_and_sync",
+    "ARRAYS_NAME", "MANIFEST_NAME",
+]

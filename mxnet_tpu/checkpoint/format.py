@@ -1,0 +1,441 @@
+"""On-disk checkpoint format: atomic directories, verifiable arrays.
+
+A checkpoint is ONE directory ``ckpt-<step>`` under a base directory::
+
+    base/
+      ckpt-0000000040/
+        arrays.npz       every tensor, stored (uncompressed) npz
+        manifest.json    per-array shape/dtype/crc32 + tensor table + meta
+      ckpt-0000000080/
+      .tmp-ckpt-0000000120.4711   <- a writer died here; never loadable
+
+Atomicity protocol (CheckFreq / Check-N-Run discipline): all files are
+written into a ``.tmp-*`` sibling, each fsynced, the temp directory
+fsynced, then ``os.rename``d onto the final name and the base directory
+fsynced. A ``ckpt-*`` directory therefore either exists with its FULL
+contents durable or does not exist at all — ``kill -9`` at any byte of
+the write leaves only a ``.tmp-*`` residue that readers never consider
+and the next writer garbage-collects.
+
+Verification: the manifest records a crc32 over every array's raw bytes
+(plus shape/dtype and file sizes). ``read_checkpoint`` recomputes and
+rejects mismatches with :class:`CheckpointCorrupt`; ``load_latest`` then
+falls back to the next-newest checkpoint that verifies. The npz container
+is loaded with ``allow_pickle=False`` so an untrusted checkpoint can never
+execute code (same stance as the legacy ``.params`` codec).
+
+Sharded arrays (mesh-bound modules): a jax array that is not fully
+replicated is saved **per shard** — one npz entry per distinct shard with
+its index window recorded in the tensor table, alongside the mesh axes and
+partition spec — and reassembled into a full host array on read.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import re
+import shutil
+import signal
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from . import atomic as _atomic
+
+__all__ = [
+    "CheckpointError", "CheckpointCorrupt", "CheckpointNotFound",
+    "FORMAT_VERSION", "MANIFEST_NAME", "ARRAYS_NAME",
+    "checkpoint_dir_name", "list_checkpoints", "probe_valid",
+    "write_checkpoint", "read_manifest", "read_checkpoint", "load_latest",
+    "collect_garbage",
+]
+
+FORMAT_VERSION = "mxnet_tpu.checkpoint/1"
+MANIFEST_NAME = "manifest.json"
+ARRAYS_NAME = "arrays.npz"
+_DIR_RE = re.compile(r"^ckpt-(\d{10})$")
+_TMP_PREFIX = ".tmp-"
+# .tmp-ckpt-<step>.<pid>.<seq> — the pid group drives dead-writer reaping;
+# the per-process sequence keeps two writers of the SAME step (a queued
+# async save racing a SIGTERM sync save) off one tmp path
+_TMP_RE = re.compile(r"^\.tmp-ckpt-\d{10}\.(\d+)\.\d+$")
+_TMP_SEQ = itertools.count()
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointError(MXNetError):
+    """Base error of the checkpoint subsystem."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """A checkpoint directory failed verification (torn write by a foreign
+    tool, bit rot, truncation): checksum/shape/dtype mismatch or an
+    unreadable container."""
+
+
+class CheckpointNotFound(CheckpointError):
+    """No loadable checkpoint exists under the base directory."""
+
+
+# Fault-injection hook for the crash-safety suite: when this env var names
+# a phase, the writer SIGKILLs its own process at that exact point —
+# the honest `kill -9 mid-write` with deterministic timing. A suffix
+# ``@N`` arms the crash on the N-th time the writer reaches that point
+# ("let two checkpoints land, die during the third"). Never set outside
+# tests.
+_CRASH_ENV = "MXNET_TPU_CKPT_TEST_CRASH"
+_crash_hits: Dict[str, int] = {}
+
+
+def _maybe_crash(point: str) -> None:
+    spec = os.environ.get(_CRASH_ENV)
+    if not spec:
+        return
+    want, _, nth = spec.partition("@")
+    if want != point:
+        return
+    if nth:
+        _crash_hits[point] = _crash_hits.get(point, 0) + 1
+        if _crash_hits[point] < int(nth):
+            return
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _crc32(arr: np.ndarray) -> int:
+    arr = np.ascontiguousarray(arr)
+    return zlib.crc32(memoryview(arr).cast("B")) & 0xFFFFFFFF
+
+
+def checkpoint_dir_name(step: int) -> str:
+    return "ckpt-%010d" % int(step)
+
+
+# ----------------------------------------------------------- shard codec
+
+def _is_sharded(val: Any) -> bool:
+    try:
+        import jax
+        return isinstance(val, jax.Array) and not val.is_fully_replicated
+    except Exception:                                      # noqa: BLE001
+        return False
+
+
+def _shard_index_meta(index, shape) -> List[Optional[List[int]]]:
+    """Normalize a shard's index (tuple of slices) to json: per dim
+    ``[lo, hi]``, or null for a full dimension."""
+    out: List[Optional[List[int]]] = []
+    for d, s in enumerate(index):
+        lo = 0 if s.start is None else int(s.start)
+        hi = int(shape[d]) if s.stop is None else int(s.stop)
+        out.append(None if (lo == 0 and hi == int(shape[d]))
+                   else [lo, hi])
+    # index tuples may be shorter than the rank (trailing full dims)
+    out.extend([None] * (len(shape) - len(index)))
+    return out
+
+
+def _decompose(name: str, val: Any, arrays: Dict[str, np.ndarray]
+               ) -> Dict[str, Any]:
+    """Stage one tensor into the flat array table; returns its tensor-table
+    entry. Sharded jax arrays are stored one entry per distinct shard."""
+    if not _is_sharded(val):
+        arrays[name] = np.asarray(val)
+        return {"kind": "full", "key": name}
+    sharding = val.sharding
+    try:
+        mesh = {str(a): int(s) for a, s in
+                zip(sharding.mesh.axis_names, sharding.mesh.devices.shape)}
+        spec = str(tuple(sharding.spec))
+    except AttributeError:                   # non-NamedSharding
+        mesh, spec = {}, repr(sharding)
+    shards_meta = []
+    seen = set()
+    for shard in val.addressable_shards:
+        idx_meta = _shard_index_meta(shard.index, val.shape)
+        key_tuple = tuple(tuple(w) if w else None for w in idx_meta)
+        if key_tuple in seen:        # replicated copy of the same window
+            continue
+        seen.add(key_tuple)
+        key = "%s@shard%d" % (name, len(shards_meta))
+        arrays[key] = np.asarray(shard.data)
+        shards_meta.append({"key": key, "index": idx_meta})
+    return {"kind": "sharded", "shape": [int(s) for s in val.shape],
+            "dtype": str(np.dtype(val.dtype)), "mesh": mesh, "spec": spec,
+            "shards": shards_meta}
+
+
+def _compose(name: str, entry: Dict[str, Any],
+             raw: Dict[str, np.ndarray]) -> np.ndarray:
+    """Inverse of :func:`_decompose` — reassemble a full host array."""
+    if entry["kind"] == "full":
+        return raw[entry["key"]]
+    shape = tuple(entry["shape"])
+    out = np.empty(shape, dtype=np.dtype(entry["dtype"]))
+    filled = 0
+    for sh in entry["shards"]:
+        window = tuple(slice(*w) if w else slice(None)
+                       for w in sh["index"])
+        piece = raw[sh["key"]]
+        out[window] = piece
+        filled += piece.size
+    if filled < out.size:
+        raise CheckpointCorrupt(
+            "sharded tensor %r: shards cover %d of %d elements"
+            % (name, filled, out.size))
+    return out
+
+
+# ------------------------------------------------------------- writing
+
+def write_checkpoint(base: str, step: int, tensors: Dict[str, Any],
+                     meta: Optional[Dict[str, Any]] = None) -> str:
+    """Write one atomic checkpoint directory; returns its path.
+
+    ``tensors`` maps name -> array-like (numpy or jax; device arrays are
+    fetched to host here — call this off the hot thread). If a VALID
+    checkpoint already exists at the target directory the write is
+    skipped (one state per step: two saves of the same step hold the
+    same params/opt state, even if their loop meta differs — e.g. an
+    epoch-end save landing on the step of the last mid-epoch save;
+    resume handles a landed-on-last-batch checkpoint by falling through
+    to the epoch-end processing). An existing directory that FAILS the
+    validity probe (bit rot, torn by a foreign tool — the thing resume
+    just fell back past) is replaced: it must not block re-checkpointing
+    the retraced step forever.
+    """
+    step = int(step)
+    os.makedirs(base, exist_ok=True)
+    final = os.path.join(base, checkpoint_dir_name(step))
+    if os.path.isdir(final):
+        if probe_valid(final):
+            return final
+        log.warning("replacing invalid existing checkpoint %s", final)
+        shutil.rmtree(final, ignore_errors=True)
+    tmp = os.path.join(base, "%sckpt-%010d.%d.%d"
+                       % (_TMP_PREFIX, step, os.getpid(), next(_TMP_SEQ)))
+    os.makedirs(tmp)
+    try:
+        arrays: Dict[str, np.ndarray] = {}
+        tensor_table = {name: _decompose(name, val, arrays)
+                        for name, val in tensors.items()}
+        arrays_path = os.path.join(tmp, ARRAYS_NAME)
+        with open(arrays_path, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        _maybe_crash("after_arrays")
+        manifest = {
+            "format": FORMAT_VERSION,
+            "step": step,
+            "arrays": {k: {"shape": [int(s) for s in v.shape],
+                           "dtype": str(v.dtype),
+                           "crc32": _crc32(v),
+                           "nbytes": int(v.nbytes)}
+                       for k, v in arrays.items()},
+            "tensors": tensor_table,
+            "files": {ARRAYS_NAME: os.path.getsize(arrays_path)},
+            "meta": meta or {},
+        }
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        _maybe_crash("after_manifest")
+        _atomic.fsync_dir(tmp)
+        _maybe_crash("before_rename")
+        try:
+            os.rename(tmp, final)
+        except OSError:
+            if not os.path.isdir(final):   # a concurrent writer of the
+                raise                      # same step won the rename
+            shutil.rmtree(tmp, ignore_errors=True)
+        _atomic.fsync_dir(base)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+# ------------------------------------------------------------- reading
+
+def list_checkpoints(base: str) -> List[Tuple[int, str]]:
+    """``[(step, path)]`` of finalized checkpoint directories, ascending
+    by step. ``.tmp-*`` residues are never listed."""
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        m = _DIR_RE.match(n)
+        if m and os.path.isdir(os.path.join(base, n)):
+            out.append((int(m.group(1)), os.path.join(base, n)))
+    out.sort()
+    return out
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise CheckpointCorrupt("unreadable manifest in %s: %s"
+                                % (path, exc)) from None
+    if not isinstance(manifest, dict) or \
+            manifest.get("format") != FORMAT_VERSION:
+        raise CheckpointCorrupt(
+            "%s: unknown checkpoint format %r"
+            % (path, manifest.get("format") if isinstance(manifest, dict)
+               else type(manifest)))
+    return manifest
+
+
+def probe_valid(path: str) -> bool:
+    """Cheap validity probe (no checksum pass): manifest parses and the
+    container files have the recorded sizes. Used by retention GC so a
+    truncated checkpoint never shields a good one from the keep quota."""
+    try:
+        manifest = read_manifest(path)
+        for fname, size in manifest.get("files", {}).items():
+            if os.path.getsize(os.path.join(path, fname)) != int(size):
+                return False
+        return True
+    except (CheckpointError, OSError, ValueError, TypeError):
+        return False
+
+
+def read_checkpoint(path: str, verify: bool = True
+                    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load one checkpoint directory -> (tensors, manifest), verifying
+    every array against its manifest record. Raises
+    :class:`CheckpointCorrupt` on ANY mismatch (wrong set of arrays,
+    shape/dtype drift, checksum failure, unreadable container)."""
+    manifest = read_manifest(path)
+    arrays_path = os.path.join(path, ARRAYS_NAME)
+    raw: Dict[str, np.ndarray] = {}
+    try:
+        with np.load(arrays_path, allow_pickle=False) as zf:
+            names = set(zf.files)
+            want = set(manifest["arrays"])
+            if names != want:
+                raise CheckpointCorrupt(
+                    "%s: array set mismatch (missing %s, unexpected %s)"
+                    % (path, sorted(want - names), sorted(names - want)))
+            for key, rec in manifest["arrays"].items():
+                arr = zf[key]            # zip-level CRC also checked here
+                if list(arr.shape) != list(rec["shape"]) or \
+                        str(arr.dtype) != rec["dtype"]:
+                    raise CheckpointCorrupt(
+                        "%s: %r is %s%s, manifest says %s%s"
+                        % (path, key, arr.dtype, arr.shape,
+                           rec["dtype"], tuple(rec["shape"])))
+                if verify and _crc32(arr) != rec["crc32"]:
+                    raise CheckpointCorrupt(
+                        "%s: checksum mismatch on %r" % (path, key))
+                raw[key] = arr
+    except CheckpointError:
+        raise
+    except Exception as exc:                               # noqa: BLE001
+        # zipfile.BadZipFile, zlib.error, OSError, ValueError: all mean
+        # the container cannot be trusted
+        raise CheckpointCorrupt("%s: unreadable array container: %s"
+                                % (path, exc)) from None
+    try:
+        tensors = {name: _compose(name, entry, raw)
+                   for name, entry in manifest.get("tensors", {}).items()}
+    except CheckpointError:
+        raise
+    except Exception as exc:                               # noqa: BLE001
+        # KeyError/TypeError from a bit-rotted tensor table (JSON that
+        # still parses but references arrays that don't exist) must stay
+        # inside the CheckpointCorrupt taxonomy or load_latest's
+        # fallback chain breaks
+        raise CheckpointCorrupt("%s: corrupt tensor table: %r"
+                                % (path, exc)) from None
+    return tensors, manifest
+
+
+def load_latest(base: str, verify: bool = True
+                ) -> Tuple[str, Dict[str, np.ndarray], Dict[str, Any]]:
+    """Newest checkpoint that VERIFIES -> (path, tensors, manifest).
+
+    Corrupt/torn candidates are skipped with a warning (counted
+    ``ckpt_load_fallback``); raises :class:`CheckpointNotFound` when
+    nothing under ``base`` loads."""
+    from .. import profiler as _profiler
+    entries = list_checkpoints(base)
+    for step, path in reversed(entries):
+        try:
+            tensors, manifest = read_checkpoint(path, verify=verify)
+            _profiler.incr_counter("ckpt_load_ok")
+            return path, tensors, manifest
+        except CheckpointCorrupt as exc:
+            _profiler.incr_counter("ckpt_load_fallback")
+            log.warning("skipping corrupt checkpoint %s (%s); "
+                        "falling back to the previous one", path, exc)
+    raise CheckpointNotFound(
+        "no loadable checkpoint under %r (%d candidate(s), all invalid)"
+        % (base, len(entries)))
+
+
+# ---------------------------------------------------------- retention GC
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True        # EPERM: exists but not ours
+
+
+def collect_garbage(base: str, keep_last: int,
+                    keep_every: Optional[int] = None) -> int:
+    """Retention: keep the newest ``keep_last`` VALID checkpoints (plus
+    every ``keep_every``-th step forever), delete the remaining valid
+    ones, and clear ``.tmp-*`` residues of dead writers. Returns the
+    number of checkpoints removed.
+
+    Safety rails: ``keep_last <= 0`` disables deletion entirely; the
+    newest valid checkpoint is never deleted; checkpoints that fail the
+    validity probe are NEVER auto-deleted (they don't count toward the
+    quota either — so GC can never leave only a corrupt checkpoint
+    behind) but are logged for the operator."""
+    from .. import profiler as _profiler
+    removed = 0
+    # reap tmp residues of writers that are gone (kill -9 mid-write)
+    try:
+        for name in os.listdir(base):
+            m = _TMP_RE.match(name)
+            if m and not _pid_alive(int(m.group(1))):
+                shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+    except OSError:
+        pass
+    if keep_last is None or keep_last <= 0:
+        return 0
+    entries = list_checkpoints(base)
+    valid = [(s, p) for s, p in entries if probe_valid(p)]
+    invalid = [p for s, p in entries if (s, p) not in valid]
+    for p in invalid:
+        log.warning("retention GC: %s fails the validity probe; leaving "
+                    "it for inspection (it does not count toward "
+                    "keep-last)", p)
+    keep = {p for _s, p in valid[-keep_last:]}
+    if keep_every and keep_every > 0:
+        keep |= {p for s, p in valid if s % keep_every == 0}
+    if valid:
+        keep.add(valid[-1][1])
+    for _step, path in valid:
+        if path in keep:
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        removed += 1
+    if removed:
+        _profiler.incr_counter("ckpt_gc_removed", removed)
+    return removed

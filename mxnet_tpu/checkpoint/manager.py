@@ -1,0 +1,508 @@
+"""Checkpoint scheduling: snapshot capture, the background writer, and
+exact-resume payloads.
+
+The CheckFreq (FAST'21) split: a checkpoint is **two** phases with very
+different costs. The *snapshot* must be consistent with a step boundary
+and is therefore on the training thread — but jax arrays are immutable,
+so on a non-donating backend grabbing references IS a complete zero-copy
+snapshot, and on donating backends one round of ``jnp.copy`` (an async
+device-side dispatch, not a transfer) protects the buffers before the
+next fused step invalidates them. The *serialization* (device→host fetch,
+checksums, npz encode, fsync) is handed to a bounded background writer
+thread, so the step loop resumes after microseconds-to-milliseconds while
+tens of megabytes drain to disk behind it. ``ckpt_block_us`` vs
+``ckpt_write_us`` counters make the split measurable (and
+counter-assertable: tools/perf/checkpoint_bench.py).
+
+``CheckpointManager.save_module`` captures everything exact resume needs:
+parameters, aux states, the fused optimizer-state pytree (or the eager
+``Updater`` blob), per-parameter update counts, epoch/batch position,
+both PRNG chains (the executor's dropout key chain and the global
+``mx.random`` chain), and the eval-metric accumulators. ``restore_latest``
+returns a :class:`Checkpoint` payload that ``Module.fit(resume_from=...)``
+replays so a killed-and-resumed run is bit-identical to an uninterrupted
+one (tests/test_checkpoint.py parity suite).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue as _queue_mod
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from . import format as _format
+from .format import (CheckpointCorrupt, CheckpointError,         # noqa: F401
+                     CheckpointNotFound)
+
+__all__ = [
+    "CheckpointConfig", "CheckpointManager", "Checkpoint",
+    "restore_latest", "restore_global_rng",
+    "tree_encode", "tree_decode", "key_to_array", "array_to_key",
+]
+
+log = logging.getLogger(__name__)
+
+
+# -------------------------------------------------- state-tree utilities
+
+def tree_encode(prefix: str, tree, tensors: Dict[str, Any],
+                grab: Callable[[Any], Any]):
+    """Flatten an optimizer-state tree (None | array | nested tuples)
+    into ``tensors`` under dotted keys; returns the json-able structure
+    descriptor ``tree_decode`` rebuilds from."""
+    if tree is None:
+        return None
+    if isinstance(tree, tuple):
+        return ["tuple", [tree_encode("%s.%d" % (prefix, i), t, tensors,
+                                      grab)
+                          for i, t in enumerate(tree)]]
+    tensors[prefix] = grab(tree)
+    return "leaf"
+
+
+def tree_decode(prefix: str, structure, tensors: Dict[str, Any],
+                leaf: Callable[[Any], Any]):
+    if structure is None:
+        return None
+    if structure == "leaf":
+        return leaf(tensors[prefix])
+    return tuple(tree_decode("%s.%d" % (prefix, i), s, tensors, leaf)
+                 for i, s in enumerate(structure[1]))
+
+
+def key_to_array(key) -> np.ndarray:
+    """Raw uint32 array form of a jax PRNG key (either flavor)."""
+    import jax
+    try:
+        import jax.numpy as jnp
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            return np.asarray(jax.random.key_data(key))
+    except (AttributeError, TypeError):
+        pass
+    return np.asarray(key)
+
+
+def array_to_key(arr: np.ndarray, like):
+    """Rebuild a PRNG key from its raw array, matching the flavor of the
+    live key ``like`` (typed key array vs raw uint32 vector)."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        if jnp.issubdtype(like.dtype, jax.dtypes.prng_key):
+            return jax.random.wrap_key_data(jnp.asarray(arr))
+    except (AttributeError, TypeError):
+        pass
+    return jnp.asarray(arr, dtype=like.dtype)
+
+
+# ----------------------------------------------------------- the config
+
+class CheckpointConfig(object):
+    """Declarative checkpoint policy for ``Module.fit(checkpoint=...)``.
+
+    Parameters
+    ----------
+    directory : str
+        Base directory holding ``ckpt-<step>`` subdirectories.
+    period_epochs : int
+        Auto-save at the end of every N-th epoch (default 1).
+    every_n_batches : int, optional
+        Additionally save mid-epoch every N batches (the in-flight window
+        is drained first so the snapshot is a step boundary).
+    keep_last : int, optional
+        Retention: newest N checkpoints kept; older ones deleted after
+        each successful save. Default: the ``MXNET_TPU_CKPT_KEEP`` knob;
+        ``0`` keeps everything.
+    keep_every : int, optional
+        Additionally keep every checkpoint whose step is a multiple of
+        this, forever (coarse history under aggressive keep_last).
+    async_save : bool, optional
+        Hand serialization to the background writer (default: the
+        ``MXNET_TPU_CKPT_ASYNC`` knob). Synchronous saves block the
+        caller for the full write.
+    save_on_sigterm : bool
+        Install a SIGTERM hook during ``fit`` (preemption notice): the
+        loop finishes the current batch, saves synchronously, and exits
+        with status 143.
+    verify_on_load : bool
+        Checksum-verify arrays when resuming (default True).
+    store_symbol : bool
+        Record the symbol JSON in the manifest for provenance.
+    queue_depth : int
+        Bounded writer queue (each queued snapshot pins one generation of
+        parameters until written; depth bounds that memory).
+    """
+
+    def __init__(self, directory: str, period_epochs: int = 1,
+                 every_n_batches: Optional[int] = None,
+                 keep_last: Optional[int] = None,
+                 keep_every: Optional[int] = None,
+                 async_save: Optional[bool] = None,
+                 save_on_sigterm: bool = True,
+                 verify_on_load: bool = True,
+                 store_symbol: bool = True,
+                 queue_depth: int = 2):
+        self.directory = str(directory)
+        self.period_epochs = int(period_epochs)
+        self.every_n_batches = None if every_n_batches is None \
+            else int(every_n_batches)
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self.async_save = async_save
+        self.save_on_sigterm = bool(save_on_sigterm)
+        self.verify_on_load = bool(verify_on_load)
+        self.store_symbol = bool(store_symbol)
+        self.queue_depth = max(1, int(queue_depth))
+
+    @classmethod
+    def coerce(cls, obj) -> "CheckpointConfig":
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, (str, os.PathLike)):
+            return cls(os.fspath(obj))
+        raise TypeError("checkpoint= accepts a directory path or a "
+                        "CheckpointConfig, got %r" % (obj,))
+
+    # knob-backed defaults resolve at use time, not construction time
+    def resolved_keep_last(self) -> int:
+        if self.keep_last is not None:
+            return int(self.keep_last)
+        from .. import config as _config
+        return int(_config.get("MXNET_TPU_CKPT_KEEP"))
+
+    def resolved_async(self) -> bool:
+        if self.async_save is not None:
+            return bool(self.async_save)
+        from .. import config as _config
+        return bool(_config.get("MXNET_TPU_CKPT_ASYNC"))
+
+
+# ---------------------------------------------------------- the payload
+
+class Checkpoint(object):
+    """A loaded checkpoint: verified host tensors + manifest, with typed
+    accessors for what ``fit(resume_from=...)`` consumes."""
+
+    def __init__(self, path: str, tensors: Dict[str, np.ndarray],
+                 manifest: Dict[str, Any]):
+        self.path = path
+        self.tensors = tensors
+        self.manifest = manifest
+
+    @property
+    def step(self) -> int:
+        return int(self.manifest.get("step", 0))
+
+    @property
+    def meta(self) -> Dict[str, Any]:
+        return self.manifest.get("meta", {})
+
+    # ------------------------------------------------------ loop position
+    @property
+    def loop(self) -> Dict[str, Any]:
+        return self.meta.get("loop") or {}
+
+    @property
+    def epoch(self) -> Optional[int]:
+        e = self.loop.get("epoch")
+        return None if e is None else int(e)
+
+    @property
+    def batches_done(self) -> Optional[int]:
+        b = self.loop.get("batches_done")
+        return None if b is None else int(b)
+
+    @property
+    def mid_epoch(self) -> bool:
+        return self.batches_done is not None
+
+    @property
+    def resume_epoch(self) -> int:
+        """First epoch the resumed run should execute (the saved epoch
+        itself when the save was mid-epoch, the next one otherwise)."""
+        if self.epoch is None:
+            return 0
+        return self.epoch if self.mid_epoch else self.epoch + 1
+
+    @property
+    def metric_state(self):
+        return self.meta.get("metric")
+
+    # -------------------------------------------------------- parameters
+    def _named(self, prefix: str, names_key: str) -> Dict[str, np.ndarray]:
+        names = self.meta.get(names_key)
+        if names is None:
+            names = [k[len(prefix):] for k in self.tensors
+                     if k.startswith(prefix)]
+        return {n: self.tensors[prefix + n] for n in names
+                if prefix + n in self.tensors}
+
+    def arg_params(self) -> Dict[str, np.ndarray]:
+        return self._named("arg:", "param_names")
+
+    def aux_params(self) -> Dict[str, np.ndarray]:
+        return self._named("aux:", "aux_names")
+
+    def arg_params_nd(self):
+        from .. import ndarray as nd
+        # dtype=v.dtype, NOT the nd.array default (which silently casts
+        # everything to float32): bit-identical resume must round-trip
+        # f64/f16/bf16 parameters at their saved precision
+        return {k: nd.array(v, dtype=v.dtype)
+                for k, v in self.arg_params().items()}
+
+    def aux_params_nd(self):
+        from .. import ndarray as nd
+        return {k: nd.array(v, dtype=v.dtype)
+                for k, v in self.aux_params().items()}
+
+
+def restore_latest(directory: str, verify: bool = True) -> Checkpoint:
+    """Load the newest valid checkpoint under ``directory`` (corrupt ones
+    are skipped with a warning) as a :class:`Checkpoint` payload."""
+    path, tensors, manifest = _format.load_latest(directory, verify=verify)
+    return Checkpoint(path, tensors, manifest)
+
+
+def restore_global_rng(ckpt: Checkpoint) -> None:
+    """Reset the global ``mx.random`` key chain to the snapshot's."""
+    raw = ckpt.tensors.get("rng:global_key")
+    if raw is None:
+        return
+    from .. import random as _random
+    _random.set_key(array_to_key(raw, like=_random.current_key()))
+
+
+# ---------------------------------------------------------- the manager
+
+class CheckpointManager(object):
+    """Owns one checkpoint directory: bounded async writer, retention GC,
+    SIGTERM preemption hook, and the profiler counters/gauges
+    (``ckpt_*``) the tests and the bench assert on."""
+
+    def __init__(self, config):
+        self.config = CheckpointConfig.coerce(config)
+        self._queue: Optional[_queue_mod.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._last_error: Optional[BaseException] = None
+        self._preempt = False
+        self._closed = False
+        self._lock = threading.Lock()
+        self._seq: Optional[int] = None
+
+    # ------------------------------------------------------------ status
+    @property
+    def last_error(self) -> Optional[BaseException]:
+        return self._last_error
+
+    @property
+    def preempt_requested(self) -> bool:
+        return self._preempt
+
+    def request_preempt(self) -> None:
+        """Ask the fit loop to checkpoint and exit at the next batch
+        boundary (what the SIGTERM hook calls)."""
+        self._preempt = True
+
+    def install_sigterm(self) -> Optional[Callable[[], None]]:
+        """Install the preemption hook; returns an uninstaller (or None
+        when not installable — non-main thread)."""
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(_signum, _frame):
+            # async-signal-safe by construction: set ONE flag and return.
+            # Taking any lock here (profiler counters, logging) deadlocks
+            # the process if the signal lands while the interrupted frame
+            # already holds it — ckpt_sigterm is counted on the training
+            # thread when the flag is observed (preempt_save)
+            self.request_preempt()
+
+        try:
+            signal.signal(signal.SIGTERM, _handler)
+        except (ValueError, OSError):
+            return None
+
+        def _restore():
+            try:
+                signal.signal(signal.SIGTERM, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+
+        return _restore
+
+    def preempt_save(self, module, epoch: Optional[int] = None,
+                     batches_done: Optional[int] = None,
+                     metric=None) -> None:
+        """The preemption-notice path (``fit`` calls this when it observes
+        :attr:`preempt_requested`): drain pending async saves, land the
+        final checkpoint synchronously, and shut the writer down. Runs on
+        the training thread — the signal handler itself only sets a flag,
+        so the ``ckpt_sigterm`` counter is bumped here."""
+        from .. import profiler as _profiler
+        _profiler.incr_counter("ckpt_sigterm")
+        self.wait()
+        self.save_module(module, epoch=epoch, batches_done=batches_done,
+                         metric=metric, sync=True)
+        # raise_errors=False: a STALE async-write failure from earlier in
+        # the run (already logged + counted) must not abort the exit-143
+        # protocol now that the final synchronous save has landed —
+        # orchestrators keyed on 143 would misread a clean preemption
+        if self._last_error is not None:
+            log.error("preemption save landed, but an earlier async "
+                      "checkpoint write had failed: %s", self._last_error)
+        self.close(raise_errors=False)
+
+    # ------------------------------------------------------------ saving
+    def save_module(self, module, epoch: Optional[int] = None,
+                    batches_done: Optional[int] = None, metric=None,
+                    sync: Optional[bool] = None) -> int:
+        """Snapshot ``module`` (+ loop position + metric accumulators)
+        and schedule the write; returns the checkpoint step. The caller
+        must have drained any in-flight window first (``fit`` does)."""
+        t0 = time.perf_counter()
+        snap = getattr(module, "_checkpoint_snapshot", None)
+        if snap is None:
+            raise CheckpointError(
+                "%s does not implement _checkpoint_snapshot; subsystem "
+                "checkpointing currently requires mx.mod.Module"
+                % type(module).__name__)
+        tensors, meta = snap()
+        meta["loop"] = {"epoch": epoch, "batches_done": batches_done}
+        if metric is not None:
+            state_fn = getattr(metric, "_ckpt_state", None)
+            meta["metric"] = state_fn() if state_fn is not None else None
+        if self.config.store_symbol and \
+                getattr(module, "symbol", None) is not None:
+            try:
+                meta["symbol"] = module.symbol.tojson()
+            except Exception:                              # noqa: BLE001
+                pass     # provenance only — never fail a save over it
+        step = int(meta.get("step", 0))
+        if "optimizer" not in meta:
+            # no optimizer update counter to advance the name: a
+            # bound-but-no-optimizer module reports step 0 on EVERY
+            # snapshot, and the one-state-per-step dedup would then
+            # silently drop every save after the first — substitute a
+            # monotonic per-directory sequence
+            if self._seq is None:
+                existing = _format.list_checkpoints(self.config.directory)
+                self._seq = max([s for s, _ in existing] or [0])
+            self._seq = max(self._seq + 1, step)
+            step = self._seq
+            meta["step"] = step
+        self._submit(step, tensors, meta, t0, sync=sync)
+        return step
+
+    def save(self, tensors: Dict[str, Any], meta: Dict[str, Any],
+             step: int, sync: Optional[bool] = None) -> None:
+        """Low-level save of an arbitrary tensor dict (the bench and
+        power users; ``fit`` goes through :meth:`save_module`)."""
+        self._submit(int(step), dict(tensors), dict(meta),
+                     time.perf_counter(), sync=sync)
+
+    def _submit(self, step, tensors, meta, t0, sync=None) -> None:
+        from .. import profiler as _profiler
+        if self._closed:
+            raise CheckpointError("CheckpointManager is closed")
+        use_async = not sync if sync is not None \
+            else self.config.resolved_async()
+        if use_async:
+            q = self._ensure_writer()
+            if q.full():
+                _profiler.incr_counter("ckpt_backpressure_wait")
+            q.put((step, tensors, meta))
+            _profiler.set_gauge("ckpt_queue_depth", q.qsize())
+            _profiler.incr_counter("ckpt_save_async")
+        else:
+            self._write_one(step, tensors, meta)
+            _profiler.incr_counter("ckpt_save_sync")
+        block_us = int((time.perf_counter() - t0) * 1e6)
+        _profiler.incr_counter("ckpt_block_us", block_us)
+        _profiler.set_gauge("ckpt_last_block_ms", block_us / 1000.0)
+
+    # ------------------------------------------------------------ writer
+    def _ensure_writer(self) -> _queue_mod.Queue:
+        with self._lock:
+            if self._queue is None:
+                self._queue = _queue_mod.Queue(
+                    maxsize=self.config.queue_depth)
+                self._thread = threading.Thread(
+                    target=self._writer_loop, name="ckpt-writer",
+                    daemon=True)
+                self._thread.start()
+            return self._queue
+
+    def _writer_loop(self) -> None:
+        from .. import profiler as _profiler
+        q = self._queue
+        while True:
+            item = q.get()
+            try:
+                if item is None:
+                    return
+                self._write_one(*item)
+            except BaseException as exc:                   # noqa: BLE001
+                # an async save failure must not kill training mid-run;
+                # it IS surfaced: counted, logged, re-raised at close()
+                if self._last_error is None:
+                    self._last_error = exc
+                _profiler.incr_counter("ckpt_write_failed")
+                log.error("async checkpoint write failed: %s", exc)
+            finally:
+                # q.get() already removed the in-flight item, so qsize()
+                # IS the number of still-pending saves
+                _profiler.set_gauge("ckpt_queue_depth", q.qsize())
+                q.task_done()
+
+    def _write_one(self, step, tensors, meta) -> None:
+        from .. import profiler as _profiler
+        t0 = time.perf_counter()
+        path = _format.write_checkpoint(self.config.directory, step,
+                                        tensors, meta)
+        try:
+            nbytes = os.path.getsize(
+                os.path.join(path, _format.ARRAYS_NAME))
+        except OSError:
+            nbytes = 0
+        _format.collect_garbage(self.config.directory,
+                                self.config.resolved_keep_last(),
+                                self.config.keep_every)
+        write_us = int((time.perf_counter() - t0) * 1e6)
+        _profiler.incr_counter("ckpt_saved")
+        _profiler.incr_counter("ckpt_bytes", nbytes)
+        _profiler.incr_counter("ckpt_write_us", write_us)
+        _profiler.set_gauge("ckpt_last_write_ms", write_us / 1000.0)
+
+    # --------------------------------------------------------- lifecycle
+    def wait(self) -> None:
+        """Block until every queued save reached disk."""
+        if self._queue is not None:
+            self._queue.join()
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Drain the queue, stop the writer, and (by default) re-raise
+        the first async write failure — a training run must not end
+        believing checkpoints exist that never hit disk."""
+        if self._closed:
+            if raise_errors and self._last_error is not None:
+                raise CheckpointError(
+                    "checkpoint write failed: %s" % self._last_error
+                ) from self._last_error
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._queue.join()
+            self._queue.put(None)
+            self._thread.join(timeout=300.0)
+        if raise_errors and self._last_error is not None:
+            raise CheckpointError(
+                "checkpoint write failed: %s" % self._last_error
+            ) from self._last_error

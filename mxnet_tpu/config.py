@@ -141,6 +141,15 @@ register("MXNET_TPU_DEVICE_METRICS", _parse_bool, True,
          "EvalMetric.update_device: accumulate (sum, count) as device "
          "reductions chained after the step, host sync deferred to "
          "get()/log boundaries; 0 = per-batch asnumpy host path")
+register("MXNET_TPU_CKPT_ASYNC", _parse_bool, True,
+         "mx.checkpoint: hand checkpoint serialization (device fetch, "
+         "checksums, npz encode, fsync) to the bounded background writer "
+         "thread so the step loop resumes after snapshot capture; 0 = "
+         "synchronous saves that block the caller for the full write")
+register("MXNET_TPU_CKPT_KEEP", int, 5,
+         "mx.checkpoint: retention — keep the newest N valid checkpoints "
+         "after each save (keep-every-K survivors and the newest valid "
+         "checkpoint are always kept); 0 = keep everything")
 register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
          "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
          "one-pass E[x^2]-E[x]^2 form — restores precision for "

@@ -223,6 +223,28 @@ class EvalMetric(object):
         self.sum_metric = 0.0
         self._dev_acc_state = None
 
+    # ---------------------------------------------------- checkpoint state
+    def _ckpt_state(self):
+        """JSON-able accumulator snapshot for mid-epoch checkpoints
+        (mx.checkpoint). Folds any device accumulator into the host totals
+        first — the checkpoint boundary is a sync point anyway — so the
+        scalar pair is the COMPLETE state for every (sum, count) metric."""
+        self._sync_device()
+        return {"kind": "scalar", "name": self.name,
+                "sum_metric": float(self.sum_metric),
+                "num_inst": int(self.num_inst)}
+
+    def _ckpt_restore(self, state) -> bool:
+        """Inverse of :meth:`_ckpt_state`; returns False (leaving the
+        freshly-reset metric untouched) on a shape it can't consume, so a
+        resumed fit degrades to epoch-start totals instead of crashing."""
+        if not isinstance(state, dict) or state.get("kind") != "scalar":
+            return False
+        self.reset()
+        self.sum_metric = float(state["sum_metric"])
+        self.num_inst = int(state["num_inst"])
+        return True
+
     def get(self):
         self._sync_device()
         if self.num_inst == 0:
@@ -283,6 +305,28 @@ class CompositeEvalMetric(EvalMetric):
     def reset(self):
         for metric in getattr(self, "metrics", []):
             metric.reset()
+
+    def _ckpt_state(self):
+        return {"kind": "composite",
+                "children": [m._ckpt_state() for m in self.metrics]}
+
+    def _ckpt_restore(self, state) -> bool:
+        if not isinstance(state, dict) or state.get("kind") != "composite":
+            return False
+        children = state.get("children") or []
+        if len(children) != len(self.metrics):
+            return False
+        restored = [m._ckpt_restore(s)
+                    for m, s in zip(self.metrics, children)]
+        if all(restored):
+            return True
+        # all-or-nothing: a half-restored composite (one child carrying
+        # full-epoch totals, the next tail-only) reports internally
+        # inconsistent metrics — on any child failure reset them ALL back
+        # to the tail-only state the caller's warning describes
+        for m in self.metrics:
+            m.reset()
+        return False
 
     def get(self):
         names, values = [], []

@@ -18,7 +18,14 @@ __all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
 
 def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict[str, NDArray],
                     aux_params: Dict[str, NDArray]) -> None:
-    """(reference: model.py:340)."""
+    """(reference: model.py:340).
+
+    Both files land atomically (temp + fsync + rename via
+    ``mx.checkpoint.atomic_open`` inside ``Symbol.save``/``nd.save``): a
+    crash mid-save can no longer tear an existing checkpoint. For
+    crash-safe *resumable* training state (optimizer, RNG, loop
+    position), use ``Module.fit(checkpoint=...)`` / ``mx.checkpoint``
+    instead — this writes params + symbol only."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}

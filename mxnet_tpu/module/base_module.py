@@ -251,10 +251,25 @@ class BaseModule(object):
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
+            monitor=None, checkpoint=None, resume_from=None):
         """Train the module (reference: base_module.py:376 — the canonical
         forward_backward → update → update_metric loop with epoch/batch
         callbacks and checkpointing hooks).
+
+        Crash-safe checkpointing (docs/architecture/checkpoint.md):
+        ``checkpoint=`` takes a ``mx.checkpoint.CheckpointConfig`` (or a
+        bare directory path) and auto-saves atomic, verifiable checkpoints
+        on the configured schedule — every N-th epoch end, optionally
+        every N batches mid-epoch (the in-flight window is drained first
+        so the snapshot is a step boundary), and on SIGTERM (preemption:
+        the current batch finishes, a synchronous save lands, and the
+        process exits with status 143). Serialization runs on a bounded
+        background writer; the loop blocks only for snapshot capture.
+        ``resume_from=`` names a checkpoint directory: the newest VALID
+        checkpoint restores parameters, aux states, optimizer state,
+        update counts, both PRNG chains, the epoch/batch position, and
+        mid-epoch metric accumulators — a killed-and-resumed run is
+        bit-identical to an uninterrupted one (tests/test_checkpoint.py).
 
         On TPU the per-batch body runs as one fused jitted step when the
         subclass provides ``_fit_step`` (Module does); otherwise it falls
@@ -277,6 +292,39 @@ class BaseModule(object):
         if initializer is None:
             initializer = Uniform(0.01)
 
+        # --------------------------------------------- checkpoint / resume
+        ckpt_mod = None
+        ckpt_mgr = None
+        resume = None
+        uninstall_sigterm = None
+        if checkpoint is not None or resume_from is not None:
+            from .. import checkpoint as ckpt_mod
+        if checkpoint is not None:
+            if getattr(self, "_checkpoint_snapshot", None) is None:
+                raise MXNetError(
+                    "fit(checkpoint=...) requires a module implementing "
+                    "_checkpoint_snapshot (mx.mod.Module); %s does not — "
+                    "use the legacy epoch_end_callback="
+                    "mx.callback.do_checkpoint(...) instead"
+                    % type(self).__name__)
+            ckpt_mgr = ckpt_mod.CheckpointManager(checkpoint)
+        if resume_from is not None:
+            resume = ckpt_mod.restore_latest(
+                str(resume_from),
+                verify=ckpt_mgr.config.verify_on_load if ckpt_mgr else True)
+            if arg_params or aux_params:
+                self.logger.warning(
+                    "fit(resume_from=%s) overrides the explicit "
+                    "arg_params/aux_params", resume.path)
+            arg_params = resume.arg_params_nd()
+            aux_params = resume.aux_params_nd()
+            force_init = True
+            begin_epoch = resume.resume_epoch
+            self.logger.info("resuming from %s (step %d, epoch %d%s)",
+                             resume.path, resume.step, begin_epoch,
+                             ", batch %d" % resume.batches_done
+                             if resume.mid_epoch else "")
+
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -287,6 +335,12 @@ class BaseModule(object):
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        if resume is not None:
+            restore = getattr(self, "_checkpoint_restore", None)
+            if restore is not None:
+                restore(resume)
+            ckpt_mod.restore_global_rng(resume)
 
         if validation_metric is None:
             validation_metric = eval_metric
@@ -307,6 +361,21 @@ class BaseModule(object):
         inflight = _fused_mod.InflightWindow(window)
         step_token = getattr(self, "_step_token", lambda: None)
 
+        resume_skip_eoe = False
+        if resume is not None and resume.mid_epoch:
+            # fast-forward the INNER iterator past the batches the
+            # interrupted run already consumed BEFORE the device-prefetch
+            # wrapper spins up its worker (no compute — the restored
+            # params/opt state already reflect those batches, and skipped
+            # batches must not be device-placed just to be discarded)
+            skip_iter = iter(train_data)
+            for _ in range(resume.batches_done):
+                try:
+                    next(skip_iter)
+                except StopIteration:
+                    resume_skip_eoe = True
+                    break
+
         wrapped = None
         inner_train_data = train_data
         if window > 0:
@@ -326,6 +395,11 @@ class BaseModule(object):
                 # those batches are placed in _load_batch instead
 
         completed = False
+        if ckpt_mgr is not None and ckpt_mgr.config.save_on_sigterm:
+            uninstall_sigterm = ckpt_mgr.install_sigterm()
+        ckpt_every_n = ckpt_mgr.config.every_n_batches if ckpt_mgr else None
+        ckpt_period = max(1, ckpt_mgr.config.period_epochs) if ckpt_mgr \
+            else 1
         try:
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.perf_counter()
@@ -333,7 +407,32 @@ class BaseModule(object):
                 nbatch = 0
                 data_iter = iter(train_data)
                 end_of_batch = False
-                next_data_batch = next(data_iter)
+                if resume is not None and resume.mid_epoch \
+                        and epoch == begin_epoch:
+                    # exact mid-epoch resume: restore the metric
+                    # accumulators the snapshot folded to host scalars
+                    # (the iterator was fast-forwarded past the consumed
+                    # batches before the prefetch wrapper was built)
+                    if resume.metric_state is not None:
+                        restore_m = getattr(eval_metric, "_ckpt_restore",
+                                            None)
+                        if restore_m is None or \
+                                not restore_m(resume.metric_state):
+                            self.logger.warning(
+                                "resume: could not restore mid-epoch "
+                                "metric state; epoch-%d training metrics "
+                                "will only cover the resumed tail", epoch)
+                    nbatch = resume.batches_done
+                    end_of_batch = resume_skip_eoe
+                next_data_batch = None
+                if not end_of_batch:
+                    try:
+                        next_data_batch = next(data_iter)
+                    except StopIteration:
+                        # resume landed exactly on the epoch's last batch:
+                        # nothing left to train, fall through to the
+                        # epoch-end processing the interrupted run missed
+                        end_of_batch = True
                 while not end_of_batch:
                     data_batch = next_data_batch
                     if monitor is not None:
@@ -371,6 +470,29 @@ class BaseModule(object):
                         for callback in _as_list(batch_end_callback):
                             callback(batch_end_params)
                     nbatch += 1
+                    if ckpt_mgr is not None:
+                        if ckpt_every_n and nbatch % ckpt_every_n == 0:
+                            # the snapshot must be a step boundary: wait
+                            # out the in-flight window, then capture (the
+                            # cheap phase) and resume the loop while the
+                            # writer drains to disk behind it
+                            inflight.drain()
+                            ckpt_mgr.save_module(self, epoch=epoch,
+                                                 batches_done=nbatch,
+                                                 metric=eval_metric)
+                        if ckpt_mgr.preempt_requested:
+                            # SIGTERM (preemption notice): finish this
+                            # batch, land a SYNCHRONOUS save, and exit
+                            # with the conventional 128+15 status
+                            inflight.drain()
+                            ckpt_mgr.preempt_save(self, epoch=epoch,
+                                                  batches_done=nbatch,
+                                                  metric=eval_metric)
+                            self.logger.warning(
+                                "SIGTERM: checkpoint saved at epoch %d "
+                                "batch %d; exiting with status 143",
+                                epoch, nbatch)
+                            raise SystemExit(143)
 
                 # epoch barrier: wait out in-flight steps so the epoch
                 # time is honest and checkpoints/eval see final state
@@ -396,6 +518,18 @@ class BaseModule(object):
                         self.logger.info("Epoch[%d] Validation-%s=%f",
                                          epoch, name, val)
 
+                if ckpt_mgr is not None:
+                    if (epoch + 1) % ckpt_period == 0:
+                        ckpt_mgr.save_module(self, epoch=epoch,
+                                             metric=eval_metric)
+                    if ckpt_mgr.preempt_requested:
+                        ckpt_mgr.preempt_save(self, epoch=epoch,
+                                              metric=eval_metric)
+                        self.logger.warning(
+                            "SIGTERM: checkpoint saved at end of epoch "
+                            "%d; exiting with status 143", epoch)
+                        raise SystemExit(143)
+
                 # after the FINAL epoch a wrapped iterator must not be
                 # reset here: the parked prefetch worker would wake and
                 # device-place batches of an epoch that never runs
@@ -406,6 +540,8 @@ class BaseModule(object):
                     train_data.reset()
             completed = True
         finally:
+            if uninstall_sigterm is not None:
+                uninstall_sigterm()
             if wrapped is not None:
                 joined = wrapped.close()
                 # leave the user's iterator exactly as the synchronous
@@ -424,6 +560,11 @@ class BaseModule(object):
                         "prefetch worker did not exit within the close() "
                         "deadline; skipping the final reset of the "
                         "training iterator")
+            if ckpt_mgr is not None:
+                # drain the background writer; surface the first async
+                # write failure ONLY on a clean run (raising here while
+                # fit is already unwinding would mask the original error)
+                ckpt_mgr.close(raise_errors=completed)
 
     def prepare(self, data_batch):
         """Prepare the module for processing a data batch (no-op by default;

@@ -555,12 +555,13 @@ def save(fname: str, data, format: str = "npz") -> None:
     if format == "mxnet":
         from . import legacy_format
         from .. import filesystem as _fs
+        from ..checkpoint.atomic import atomic_open
         if isinstance(data, dict):
             blob = {k: np.asarray(v.asnumpy()) for k, v in data.items()}
         else:
             blob = [np.asarray(a.asnumpy()) for a in data]
         with _fs.open_uri(fname, "w") as path:
-            with open(path, "wb") as f:
+            with atomic_open(path, "wb") as f:
                 f.write(legacy_format.save_bytes(blob))
         return
     if format != "npz":
@@ -582,9 +583,13 @@ def save(fname: str, data, format: str = "npz") -> None:
     manifest = np.array(
         ["dict" if keys is not None else "list"] + [k for k in payload.keys()],
         dtype=np.str_)
+    # atomic: temp file + fsync + rename (checkpoint.atomic) — a crash or
+    # kill -9 mid-write can no longer leave a torn archive at the final
+    # name, and the previous file survives any failed save
     from .. import filesystem as _fs
+    from ..checkpoint.atomic import atomic_open
     with _fs.open_uri(fname, "w") as path:   # s3://, hdfs://, local
-        with open(path, "wb") as f:
+        with atomic_open(path, "wb") as f:
             np.savez(f, __manifest__=manifest, **payload)
 
 

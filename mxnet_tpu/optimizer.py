@@ -819,7 +819,12 @@ def _state_from_np(v, legacy=False):
     if isinstance(v, _NDTag) or (legacy and isinstance(v, np.ndarray)):
         import jax.numpy as jnp
         raw = v.value if isinstance(v, _NDTag) else v
-        return NDArray(jnp.asarray(raw))
+        # jnp.array, NOT jnp.asarray: on the CPU backend asarray can
+        # zero-copy ALIAS the unpickled numpy buffer, and a buffer that
+        # shares host memory must never be donated to the fused update
+        # program (use-after-free once XLA recycles it). An owned copy
+        # also keeps the restored state independent of the caller's blob.
+        return NDArray(jnp.array(raw))
     return v
 
 

@@ -224,13 +224,19 @@ class Predictor(object):
             "platforms": list(exported.platforms),
         }
         from . import filesystem as _fs
+        from .checkpoint.atomic import atomic_open
         with _fs.open_uri(path, "w") as local:   # s3://, hdfs://, local
-            with zipfile.ZipFile(local, "w") as z:
-                z.writestr("manifest.json", json.dumps(manifest, indent=1))
-                z.writestr("program.stablehlo", exported.serialize())
-                buf = io.BytesIO()
-                np.savez(buf, **weights)
-                z.writestr("weights.npz", buf.getvalue())
+            # atomic: the zip grows through a fsynced temp file renamed
+            # over the target, so a crash mid-export can't leave a torn
+            # (half-written central directory) artifact at the final name
+            with atomic_open(local, "wb") as fobj:
+                with zipfile.ZipFile(fobj, "w") as z:
+                    z.writestr("manifest.json",
+                               json.dumps(manifest, indent=1))
+                    z.writestr("program.stablehlo", exported.serialize())
+                    buf = io.BytesIO()
+                    np.savez(buf, **weights)
+                    z.writestr("weights.npz", buf.getvalue())
         return path
 
     # ------------------------------------------------------------ loaders

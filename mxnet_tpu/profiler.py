@@ -15,6 +15,18 @@ Two layers here:
 * The XLA-level profiler: ``start_xla_trace(logdir)`` /
   ``stop_xla_trace()`` wrap ``jax.profiler`` for TensorBoard-grade HLO
   timelines on real hardware.
+
+Counters/gauges are a third, always-on layer (string-keyed, thread-safe)
+used by subsystems to make their hot-path invariants assertable. The
+checkpoint subsystem's family (docs/architecture/checkpoint.md):
+``ckpt_block_us`` (training-thread time spent in snapshot+submit — the
+number that must stay small) vs ``ckpt_write_us`` (background
+serialization+fsync time), ``ckpt_saved`` / ``ckpt_bytes`` /
+``ckpt_save_async`` / ``ckpt_save_sync``, ``ckpt_backpressure_wait``
+(writer queue was full at submit), ``ckpt_write_failed``,
+``ckpt_load_ok`` / ``ckpt_load_fallback`` (corrupt candidate skipped),
+``ckpt_gc_removed``, ``ckpt_sigterm``, and gauges ``ckpt_queue_depth``,
+``ckpt_last_block_ms``, ``ckpt_last_write_ms``.
 """
 from __future__ import annotations
 

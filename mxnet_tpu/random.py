@@ -12,7 +12,7 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "current_key"]
+__all__ = ["seed", "next_key", "current_key", "set_key"]
 
 _state = threading.local()
 
@@ -38,3 +38,11 @@ def next_key():
 
 def current_key():
     return _key()
+
+
+def set_key(key) -> None:
+    """Restore the generator to an exact previously-captured key — the
+    checkpoint-resume twin of ``seed()``: ``mx.checkpoint`` snapshots
+    ``current_key()`` and replays it here so every sampler op after a
+    resume draws the same stream as the uninterrupted run."""
+    _state.key = key

@@ -381,7 +381,8 @@ class Symbol:
             "heads": heads}, indent=2)
 
     def save(self, fname: str) -> None:
-        with open(fname, "w") as f:
+        from ..checkpoint.atomic import atomic_open
+        with atomic_open(fname, "w") as f:
             f.write(self.tojson())
 
     # ------------------------------------------------------------ eval/bind

@@ -59,6 +59,7 @@ _MULTIDEVICE_TEST_MODULES = {
     "test_kvstore_parallel", "test_model_parallel", "test_moe",
     "test_pipeline_module", "test_pipeline_parallel",
     "test_tensor_parallel", "test_transformer", "test_dist",
+    "test_checkpoint",
 }
 
 

@@ -1,0 +1,48 @@
+"""Tier-1 smoke for tools/perf/checkpoint_bench.py (not slow).
+
+Runs the quick variant end-to-end (real Module, real fused steps, real
+atomic writes) and asserts the mechanics: every save landed, none
+failed, and the async submit blocked the training thread for a small
+fraction of the background serialization time — the CheckFreq split the
+tentpole exists for. The threshold here is looser than the full bench's
+25% gate (shared CI hosts are noisy; the full bench enforces 25% and
+records the honest number into BENCH_checkpoint.json)."""
+import importlib
+import json
+import os
+import sys
+
+
+def _load_bench():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "perf"))
+    try:
+        return importlib.import_module("checkpoint_bench")
+    finally:
+        sys.path.pop(0)
+
+
+def test_checkpoint_bench_quick(tmp_path):
+    bench = _load_bench()
+    results = bench.run(quick=True)
+    for k in ("saves", "ckpt_mbytes", "async_block_ms_per_save",
+              "async_write_ms_per_save", "block_fraction_of_write",
+              "sync_block_ms_per_save", "async_vs_sync_block_speedup",
+              "saved", "write_failed"):
+        assert k in results, "missing %s" % k
+    assert results["saved"] == results["saves"]
+    assert results["write_failed"] == 0
+    assert results["ckpt_mbytes"] > 0
+    assert results["async_write_ms_per_save"] > 0
+    # the split itself: blocking well under serialization time even on a
+    # loaded box (full bench gates the honest <0.25)
+    assert results["block_fraction_of_write"] < 0.6, results
+
+    # artifact schema BENCH_checkpoint.json consumers read
+    path = str(tmp_path / "BENCH_checkpoint.json")
+    with open(path, "w") as f:
+        json.dump({"bench": "checkpoint", "results": results}, f)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["results"]["saved"] == results["saves"]

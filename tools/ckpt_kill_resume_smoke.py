@@ -1,0 +1,131 @@
+"""End-to-end crash-safety smoke for mx.checkpoint (CI `checkpoint` step,
+also driven by tests/test_checkpoint.py::test_kill9_resume_smoke_script).
+
+The honest failure drill, in one script:
+
+1. a child process trains with async checkpointing and is SIGKILLed
+   DURING a checkpoint write (deterministically, via the
+   ``MXNET_TPU_CKPT_TEST_CRASH=<point>@<n>`` fault hook — the N-th write
+   dies mid-``arrays.npz``);
+2. the parent verifies the torn write left only a ``.tmp-*`` residue and
+   earlier checkpoints verify clean;
+3. the parent then byte-flips the NEWEST surviving checkpoint (bit-rot),
+   so resume must detect the corruption and fall back another step;
+4. ``fit(resume_from=...)`` completes the run from the oldest surviving
+   checkpoint and must reproduce an uninterrupted run's params
+   BIT-IDENTICALLY.
+
+Exit 0 + ``KILL-RESUME-PARITY-OK`` on success; any assertion kills CI.
+"""
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+BATCH, NSAMP, FEAT, NCLS = 8, 64, 16, 8
+EPOCHS = 4
+
+
+def _symbol():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=12, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=NCLS, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (rng.uniform(-1, 1, (NSAMP, FEAT)).astype(np.float32),
+            rng.randint(0, NCLS, (NSAMP,)).astype(np.float32))
+
+
+def _train(epochs, ckpt_dir=None, resume=None, seed=True):
+    import mxnet_tpu as mx
+    mx.random.seed(7)
+    sym = _symbol()
+    X, Y = _data()
+    kw = {}
+    if seed:
+        rng = np.random.RandomState(42)
+        args, _, _ = sym.infer_shape(data=(BATCH, FEAT),
+                                     softmax_label=(BATCH,))
+        kw["arg_params"] = {
+            n: mx.nd.array(rng.uniform(-0.1, 0.1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), args)
+            if n not in ("data", "softmax_label")}
+    ckpt = None
+    if ckpt_dir is not None:
+        ckpt = mx.checkpoint.CheckpointConfig(
+            ckpt_dir, every_n_batches=3, period_epochs=1, keep_last=0)
+    it = mx.io.NDArrayIter(X, Y, batch_size=BATCH)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            checkpoint=ckpt, resume_from=resume, **kw)
+    arg, _aux = mod.get_params()
+    return {k: v.asnumpy().copy() for k, v in arg.items()}
+
+
+def main():
+    if "--child" in sys.argv:
+        _train(EPOCHS, ckpt_dir=sys.argv[sys.argv.index("--child") + 1])
+        print("CHILD-FINISHED-WITHOUT-CRASH")       # must not be reached
+        return 1
+
+    import mxnet_tpu as mx
+    base = tempfile.mkdtemp(prefix="ckpt_smoke_")
+
+    # ---- 1. child dies mid-write of its 3rd checkpoint ------------------
+    env = {**os.environ, "PYTHONPATH": "",
+           "MXNET_TPU_CKPT_TEST_CRASH": "after_arrays@3"}
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", base],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == -signal.SIGKILL, \
+        "child should die by SIGKILL, got rc=%s\n%s%s" % (
+            proc.returncode, proc.stdout, proc.stderr)
+    assert "CHILD-FINISHED-WITHOUT-CRASH" not in proc.stdout
+
+    # ---- 2. torn write left residue only; survivors verify -------------
+    entries = mx.checkpoint.list_checkpoints(base)
+    steps = [s for s, _ in entries]
+    assert len(steps) >= 2, "expected >=2 surviving checkpoints, got %s" \
+        % steps
+    residue = [n for n in os.listdir(base) if n.startswith(".tmp-")]
+    assert residue, "SIGKILL mid-write should leave a .tmp-* residue"
+    for _s, p in entries:
+        mx.checkpoint.read_checkpoint(p)            # full checksum pass
+    print("survivors verify clean: steps=%s residue=%s" % (steps, residue))
+
+    # ---- 3. bit-rot the newest survivor: resume must fall back ---------
+    newest = entries[-1][1]
+    arrays = os.path.join(newest, "arrays.npz")
+    blob = bytearray(open(arrays, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(arrays, "wb").write(bytes(blob))
+
+    # ---- 4. exact-resume parity ----------------------------------------
+    w_ref = _train(EPOCHS)
+    w_res = _train(EPOCHS, resume=base, seed=False)
+    assert set(w_ref) == set(w_res)
+    for k in sorted(w_ref):
+        np.testing.assert_array_equal(w_ref[k], w_res[k], err_msg=k)
+
+    from mxnet_tpu import profiler
+    assert profiler.get_counter("ckpt_load_fallback") >= 1, \
+        "resume should have skipped the corrupted newest checkpoint"
+    print("KILL-RESUME-PARITY-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

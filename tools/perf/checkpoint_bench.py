@@ -1,0 +1,186 @@
+"""mx.checkpoint blocking-time bench: how long does the training thread
+stop when a checkpoint is taken? (ISSUE 5 — the CheckFreq split.)
+
+A checkpoint is two phases with very different costs: the *snapshot*
+(device-side ``jnp.copy`` of params/optimizer-states + queue handoff,
+on the training thread) and the *serialization* (device->host fetch,
+crc32, npz encode, double fsync — on the background writer). The bench
+drives a real ``Module`` mid-training and measures both via the
+``ckpt_block_us`` / ``ckpt_write_us`` profiler counters, plus a
+synchronous-save baseline where the training thread eats the whole
+write.
+
+The acceptance gate (counter-asserted here and in
+tests/test_checkpoint_bench.py): async saves block the step loop for
+**< 25% of the total serialization time** on the MLP workload.
+
+Usage: python tools/perf/checkpoint_bench.py [--quick] [--json PATH]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+FEAT = 1024
+NCLS = 10
+BATCH = 32
+
+
+def _mlp_symbol(hidden):
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=hidden, name="fc2")
+    act2 = mx.sym.Activation(fc2, act_type="relu", name="relu2")
+    fc3 = mx.sym.FullyConnected(act2, num_hidden=NCLS, name="fc3")
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def _make_module(hidden):
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    mod = mx.mod.Module(_mlp_symbol(hidden), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (BATCH, FEAT))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params(initializer=mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 1e-3})
+    return mod
+
+
+def _step(mod, rng):
+    import mxnet_tpu as mx
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.uniform(-1, 1, (BATCH, FEAT))
+                          .astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, NCLS, (BATCH,))
+                           .astype(np.float32))])
+    mod._fit_step(batch)
+
+
+def run(quick=False):
+    """Returns the record BENCH_checkpoint.json stores. ``quick`` shrinks
+    the model and save count for the tier-1 smoke."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.checkpoint import CheckpointConfig, CheckpointManager
+    import tempfile
+    import shutil
+
+    hidden = 256 if quick else 1024
+    saves = 4 if quick else 10
+    steps_between = 2
+    rng = np.random.RandomState(3)
+    mod = _make_module(hidden)
+    for _ in range(3):                       # warm the fused step
+        _step(mod, rng)
+
+    results = {}
+
+    # ---------------------------------------------------- async pipeline
+    # The writer is drained between saves (checkpoint periods in real
+    # training are minutes, not back-to-back) so ckpt_block_us measures
+    # the per-save blocking itself — snapshot copies + queue handoff —
+    # not backpressure from an artificially saturated writer. A second
+    # pass WITHOUT draining reports the saturated (backpressure) regime.
+    base = tempfile.mkdtemp(prefix="ckpt_bench_async_")
+    mgr = CheckpointManager(CheckpointConfig(base, async_save=True,
+                                             keep_last=2))
+    with profiler.counter_delta() as d:
+        for _ in range(saves):
+            for _ in range(steps_between):
+                _step(mod, rng)
+            mgr.save_module(mod)
+            mgr.wait()
+        async_counts = d.all()
+    mgr.close()
+    block_us = async_counts.get("ckpt_block_us", 0)
+    write_us = async_counts.get("ckpt_write_us", 0)
+    nbytes = async_counts.get("ckpt_bytes", 0)
+    shutil.rmtree(base, ignore_errors=True)
+
+    # ------------------------------------- saturated (backpressure) pass
+    base = tempfile.mkdtemp(prefix="ckpt_bench_sat_")
+    mgr = CheckpointManager(CheckpointConfig(base, async_save=True,
+                                             keep_last=2))
+    with profiler.counter_delta() as d:
+        for _ in range(saves):
+            _step(mod, rng)
+            mgr.save_module(mod)
+        mgr.wait()
+        sat_counts = d.all()
+    mgr.close()
+    shutil.rmtree(base, ignore_errors=True)
+
+    # ------------------------------------------------- synchronous saves
+    base = tempfile.mkdtemp(prefix="ckpt_bench_sync_")
+    mgr = CheckpointManager(CheckpointConfig(base, async_save=False,
+                                             keep_last=2))
+    with profiler.counter_delta() as d:
+        for _ in range(saves):
+            for _ in range(steps_between):
+                _step(mod, rng)
+            mgr.save_module(mod)
+        sync_counts = d.all()
+    mgr.close()
+    sync_block_us = sync_counts.get("ckpt_block_us", 0)
+    shutil.rmtree(base, ignore_errors=True)
+
+    results = {
+        "saves": saves,
+        "ckpt_mbytes": round(nbytes / saves / 1e6, 3),
+        "async_block_ms_per_save": round(block_us / saves / 1e3, 3),
+        "async_write_ms_per_save": round(write_us / saves / 1e3, 3),
+        "block_fraction_of_write": round(block_us / max(1, write_us), 4),
+        "saturated_block_ms_per_save": round(
+            sat_counts.get("ckpt_block_us", 0) / saves / 1e3, 3),
+        "saturated_backpressure_waits": sat_counts.get(
+            "ckpt_backpressure_wait", 0),
+        "sync_block_ms_per_save": round(sync_block_us / saves / 1e3, 3),
+        "async_vs_sync_block_speedup": round(
+            sync_block_us / max(1, block_us), 2),
+        "saved": async_counts.get("ckpt_saved", 0),
+        "write_failed": async_counts.get("ckpt_write_failed", 0)
+        + sat_counts.get("ckpt_write_failed", 0)
+        + sync_counts.get("ckpt_write_failed", 0),
+    }
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    results = run(quick=args.quick)
+    record = {
+        "bench": "checkpoint",
+        "quick": bool(args.quick),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "results": results,
+    }
+    print(json.dumps(record, indent=2))
+    frac = results["block_fraction_of_write"]
+    if not args.quick:
+        assert frac < 0.25, \
+            "async save blocked %.1f%% of write time (gate: <25%%)" \
+            % (100 * frac)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
