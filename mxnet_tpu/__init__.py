@@ -41,6 +41,7 @@ from . import storage
 from . import io
 from . import image
 from . import profiler
+from . import obs
 from . import monitor
 from . import monitor as mon
 from . import visualization

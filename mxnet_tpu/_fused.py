@@ -72,14 +72,16 @@ class InflightWindow:
         self._fifo.append(token)
         if len(self._fifo) > self.depth:
             _profiler.incr_counter("loop_window_wait")
-            jax.block_until_ready(self._fifo.pop(0))
+            with _profiler.span("inflight_retire", "step"):
+                jax.block_until_ready(self._fifo.pop(0))
 
     def drain(self) -> None:
         """Epoch/teardown barrier: wait out every in-flight step (so epoch
         wall-clock logs and checkpoints see completed state)."""
         if self._fifo:
             _profiler.incr_counter("loop_window_drain")
-            jax.block_until_ready(self._fifo)
+            with _profiler.span("inflight_drain", "step"):
+                jax.block_until_ready(self._fifo)
             self._fifo.clear()
 
 
@@ -434,6 +436,7 @@ class FusedUpdater:
         # the global tape (lazy import: autograd imports this module)
         from . import autograd as _autograd
         donate = jax.default_backend() != "cpu"
+        from .obs import compiles as _obs_compiles
         prev_rec = _autograd.set_recording(False)
         try:
             runner = self.cache.get(sig)
@@ -451,7 +454,9 @@ class FusedUpdater:
                     call_w = [jnp.copy(w) for w in weights]
                     call_s = jax.tree_util.tree_map(jnp.copy, states_raw)
                 try:
-                    new_ws, new_ss = runner(call_w, grads, call_s, hypers)
+                    with _obs_compiles.scope("trainer_step", sig):
+                        new_ws, new_ss = runner(call_w, grads, call_s,
+                                                hypers)
                 except Exception as e:                     # noqa: BLE001
                     self.cache.mark_failed(sig,
                                            permanent=structural_failure(e))

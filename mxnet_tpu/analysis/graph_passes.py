@@ -312,6 +312,15 @@ def _node_flops(node, in_avals, out_avals) -> int:
         if name in ("dot", "batch_dot", "linalg_gemm2"):
             k = in_avals[0][0][-1]
             return 2 * _nelem(out_avals[0][0]) * int(k)
+        if name == "FlashAttention":
+            # fused QK^T + softmax-weighted V: two (T x d)·(d x T)-class
+            # contractions per head — 4*T*d FLOPs per output element
+            # (q: (..., T, d)); the default one-per-element rule would
+            # undercount attention ~15x, skewing the obs_mfu gauge on
+            # flash-attention transformers (ISSUE 6 cross-check)
+            q_shape = in_avals[0][0]
+            t, d = int(q_shape[-2]), int(q_shape[-1])
+            return 4 * _nelem(q_shape[:-2]) * t * t * d
         if name == "Embedding":
             return 0                               # a gather, no FLOPs
         if name in ("BatchNorm", "BatchNorm_v1", "LayerNorm",

@@ -367,6 +367,7 @@ class CheckpointManager(object):
         """Snapshot ``module`` (+ loop position + metric accumulators)
         and schedule the write; returns the checkpoint step. The caller
         must have drained any in-flight window first (``fit`` does)."""
+        from .. import profiler as _profiler
         t0 = time.perf_counter()
         snap = getattr(module, "_checkpoint_snapshot", None)
         if snap is None:
@@ -374,7 +375,10 @@ class CheckpointManager(object):
                 "%s does not implement _checkpoint_snapshot; subsystem "
                 "checkpointing currently requires mx.mod.Module"
                 % type(module).__name__)
-        tensors, meta = snap()
+        # the cheap on-thread phase of the CheckFreq split, visible on the
+        # caller's (training) lane next to the step slices
+        with _profiler.span("ckpt_snapshot", "ckpt"):
+            tensors, meta = snap()
         meta["loop"] = {"epoch": epoch, "batches_done": batches_done}
         if metric is not None:
             state_fn = getattr(metric, "_ckpt_state", None)
@@ -442,6 +446,7 @@ class CheckpointManager(object):
 
     def _writer_loop(self) -> None:
         from .. import profiler as _profiler
+        _profiler.register_thread_lane("ckpt-writer")
         q = self._queue
         while True:
             item = q.get()
@@ -465,8 +470,9 @@ class CheckpointManager(object):
     def _write_one(self, step, tensors, meta) -> None:
         from .. import profiler as _profiler
         t0 = time.perf_counter()
-        path = _format.write_checkpoint(self.config.directory, step,
-                                        tensors, meta)
+        with _profiler.span("ckpt_write", "ckpt"):
+            path = _format.write_checkpoint(self.config.directory, step,
+                                            tensors, meta)
         try:
             nbytes = os.path.getsize(
                 os.path.join(path, _format.ARRAYS_NAME))

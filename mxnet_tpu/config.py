@@ -150,6 +150,20 @@ register("MXNET_TPU_CKPT_KEEP", int, 5,
          "mx.checkpoint: retention — keep the newest N valid checkpoints "
          "after each save (keep-every-K survivors and the newest valid "
          "checkpoint are always kept); 0 = keep everything")
+register("MXNET_TPU_OBS", _parse_bool, False,
+         "mx.obs: record structured spans (per-thread lanes + chrome-trace "
+         "flow events linking one batch across prefetch/train/metric/"
+         "checkpoint/serve threads) into the profiler event buffer even "
+         "while the profiler state is 'stop'; 0 = span() is a shared "
+         "no-op (zero allocations — counter-asserted by tests/test_obs.py)")
+register("MXNET_TPU_OBS_METRICS_PORT", int, -1,
+         "mx.obs: HTTP /metrics exposition (Prometheus text format) "
+         "auto-started by serve.InferenceServer: -1 = off, 0 = ephemeral "
+         "port (read it back from server.metrics_port), >0 = fixed port")
+register("MXNET_TPU_OBS_PEAK_FLOPS", float, 0.0,
+         "mx.obs: override the device's peak dense FLOP/s used for the "
+         "obs_mfu gauge (0 = auto-detect by TPU device_kind; set "
+         "explicitly on unknown devices or in tests)")
 register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
          "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
          "one-pass E[x^2]-E[x]^2 form — restores precision for "

@@ -37,6 +37,7 @@ from .base import MXNetError
 from .context import Context
 from . import ndarray as _nd
 from . import random as _random
+from .obs import compiles as _obs_compiles
 
 __all__ = ["Executor", "graph_function"]
 
@@ -190,6 +191,12 @@ class Executor:
 
         self._group2ctx = group2ctx
         self._shared_exec = shared_exec
+        # compile-accounting label: every jit dispatch below runs under
+        # an obs compile scope so a bind/trace wedge is attributable to
+        # this executor in mx.obs.report() (docs/architecture/
+        # observability.md)
+        self._obs_label = "graph:%s" % (
+            self._output_names[0] if self._output_names else "?")
         self._fn = graph_function(symbol, self._node_device_fn())
         # programs embedding host-callback custom ops must run
         # synchronously with the frontend: async execution + concurrent
@@ -336,8 +343,9 @@ class Executor:
             self._pending = (arg_vals, aux_vals, key)
             self._outputs = None
         else:
-            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
-                                          bool(is_train))
+            with _obs_compiles.scope(self._obs_label):
+                outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
+                                              bool(is_train))
             if self._sync_host_callbacks:
                 self._forced_sync(outs)
             self._commit(outs, new_aux)
@@ -364,14 +372,16 @@ class Executor:
         if _profiler.state() == "run":
             import time as _time
             _t0 = _time.perf_counter()
-            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
-                                                     key, heads)
+            with _obs_compiles.scope(self._obs_label):
+                outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
+                                                         key, heads)
             jax.block_until_ready(outs)
             _profiler.record_event("graph_fwd_bwd", _t0,
                                    _time.perf_counter(), "graph")
         else:
-            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
-                                                     key, heads)
+            with _obs_compiles.scope(self._obs_label):
+                outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
+                                                         key, heads)
         if self._sync_host_callbacks:
             self._forced_sync((outs, grads))
         self._commit(outs, new_aux)
@@ -407,7 +417,8 @@ class Executor:
         training forward is pending."""
         if self._outputs is None and self._pending is not None:
             arg_vals, aux_vals, key = self._pending
-            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key, True)
+            with _obs_compiles.scope(self._obs_label):
+                outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key, True)
             if self._sync_host_callbacks:
                 self._forced_sync(outs)
             self._commit(outs, new_aux)

@@ -394,6 +394,11 @@ class BaseModule(object):
                 # thread and a queue hop just for the placement stage —
                 # those batches are placed in _load_batch instead
 
+        # the training thread's trace lane: step/checkpoint-snapshot spans
+        # land here; metric syncs get their own track (docs/architecture/
+        # observability.md lane map)
+        _profiler.register_thread_lane("train")
+
         completed = False
         if ckpt_mgr is not None and ckpt_mgr.config.save_on_sigterm:
             uninstall_sigterm = ckpt_mgr.install_sigterm()
@@ -435,26 +440,38 @@ class BaseModule(object):
                         end_of_batch = True
                 while not end_of_batch:
                     data_batch = next_data_batch
+                    # the batch's flow id threads its trace slices across
+                    # lanes (prefetch -> place -> step -> metric); batches
+                    # the prefetch stage produced already carry one
+                    fid = getattr(data_batch, "_mx_flow", None)
+                    if fid is None and _profiler.spans_enabled():
+                        fid = _profiler.new_flow()
                     if monitor is not None:
                         monitor.tic()
-                    if fused is not None and monitor is None:
-                        fused(data_batch)
-                    else:
-                        self.forward_backward(data_batch)
-                        self.update()
+                    with _profiler.span("fused_step_dispatch", "step",
+                                        flow=fid):
+                        if fused is not None and monitor is None:
+                            fused(data_batch)
+                        else:
+                            self.forward_backward(data_batch)
+                            self.update()
                     if window > 0:
                         inflight.push(step_token())
                     # metric BEFORE prepare: prepare may switch the current
                     # bucket module, whose outputs are not this batch's
-                    if window > 0 and update_device is not None and \
-                            update_device(eval_metric, data_batch.label):
-                        pass    # chained device reduction, no host sync
-                    else:
-                        if window > 0:
-                            # the async loop had to sync for this metric:
-                            # visible per-batch pipeline break
-                            _profiler.incr_counter("loop_host_sync")
-                        self.update_metric(eval_metric, data_batch.label)
+                    with _profiler.span("metric_update", "metric",
+                                        flow=fid, lane="metric"):
+                        if window > 0 and update_device is not None and \
+                                update_device(eval_metric,
+                                              data_batch.label):
+                            pass  # chained device reduction, no host sync
+                        else:
+                            if window > 0:
+                                # the async loop had to sync for this
+                                # metric: visible per-batch pipeline break
+                                _profiler.incr_counter("loop_host_sync")
+                            self.update_metric(eval_metric,
+                                               data_batch.label)
                     try:
                         next_data_batch = next(data_iter)
                         self.prepare(next_data_batch)
@@ -497,7 +514,11 @@ class BaseModule(object):
                 # epoch barrier: wait out in-flight steps so the epoch
                 # time is honest and checkpoints/eval see final state
                 inflight.drain()
-                for name, val in eval_metric.get_name_value():
+                # the ONE host metric fetch of the epoch (async loop):
+                # visible as a metric-lane span at the log boundary
+                with _profiler.span("metric_sync", "metric", lane="metric"):
+                    name_values = eval_metric.get_name_value()
+                for name, val in name_values:
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
                 toc = time.perf_counter()
                 self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))

@@ -16,6 +16,7 @@ buffers so weights never leave HBM.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -32,6 +33,8 @@ from ..model import _create_kvstore, load_checkpoint, save_checkpoint
 from .. import config as _config
 from .. import _fused
 from .. import profiler as _profiler
+from ..obs import compiles as _obs_compiles
+from ..obs import mfu as _obs_mfu
 from .base_module import BaseModule, _check_input_names
 from ..io.io import DataDesc
 
@@ -114,6 +117,16 @@ class Module(BaseModule):
         self._fused_out = None      # outputs of the last fused step
         self._fused_states = None   # optimizer-state pytree for fused path
         self._fused_num_update = 0
+
+        # obs utilization accounting (docs/architecture/observability.md):
+        # per-step cost is two attribute writes + one perf_counter read;
+        # rates/MFU are computed lazily by mx.obs.report()
+        self._obs_steps = 0
+        self._obs_t0 = None
+        self._obs_baseline = None
+        self._obs_flops_per_step = None
+        self._obs_label = "module"
+        self._obs_sig = None
 
     # ------------------------------------------------------------- loading
     @staticmethod
@@ -749,9 +762,16 @@ class Module(BaseModule):
                 lr = self._optimizer.lr_scheduler(t)
             else:
                 lr = self._optimizer.lr
-            outs, new_params, new_states, new_aux = self._fused_jit(
-                params, states, aux, inputs, frozen_vals, key,
-                jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.int32))
+            with _obs_compiles.scope("fused_step", self._obs_sig):
+                outs, new_params, new_states, new_aux = self._fused_jit(
+                    params, states, aux, inputs, frozen_vals, key,
+                    jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.int32))
+            n = self._obs_steps + 1
+            self._obs_steps = n
+            if n == _obs_mfu.OBS_WARMUP_STEPS:
+                # rate window opens after the compile steps; report()
+                # closes it (and re-opens) at each collect
+                self._obs_t0 = time.perf_counter()
             cache_size = getattr(self._fused_jit, "_cache_size", None)
             if cache_size is not None:
                 # steady-state recompiles are a bug the async tests assert
@@ -779,6 +799,17 @@ class Module(BaseModule):
             ex._outputs = self._fused_out
             ex._pending = None
             self._params_dirty = True
+
+        # obs identity for compile attribution + the MFU collector; the
+        # static FLOP estimate is invalidated here because a rebuild means
+        # shapes (reshape) or structure changed
+        self._obs_label = "fused_step:%s" % (
+            self._output_names[0] if self._output_names else "?")
+        self._obs_sig = (self._obs_label,
+                         tuple((d.name, tuple(d.shape))
+                               for d in self._data_shapes or ()))
+        self._obs_flops_per_step = None
+        _obs_mfu.register_executor(self)
 
         if getattr(self, "_fused_states", None) is None or \
                 set(self._fused_states) != set(param_names):

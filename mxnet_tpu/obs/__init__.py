@@ -1,0 +1,88 @@
+"""``mx.obs`` — unified observability: trace timeline, metrics
+exposition, and always-on utilization/compile accounting
+(docs/architecture/observability.md).
+
+The reference framework's engine emits a flat chrome://tracing timeline
+(src/engine/profiler.cc:127-179); this stack is deeply asynchronous —
+prefetch worker, training thread, in-flight window, checkpoint writer,
+serve coalescer — so the timeline here is **structured**: spans on
+stable named lanes with chrome-trace flow events linking one batch or
+request across threads. Four surfaces, one module:
+
+* **Spans / lanes / flows** (re-exported from :mod:`mxnet_tpu.profiler`,
+  where subsystems record without importing obs): ``span()``,
+  ``new_flow()``, ``register_thread_lane()``; enabled by the profiler
+  state or the ``MXNET_TPU_OBS`` knob, shared-no-op otherwise.
+* **Metrics exposition**: ``render_prometheus()`` over the always-on
+  counters/gauges/histograms, ``parse_prometheus()`` as the pure-Python
+  grammar check, and an opt-in HTTP ``/metrics`` endpoint
+  (``start_metrics_server``, auto-wired into ``serve.InferenceServer``
+  via ``MXNET_TPU_OBS_METRICS_PORT``).
+* **Compile accounting** (always on, :mod:`.compiles`): every executable
+  build is attributed to its dispatch site + cache signature via
+  jax.monitoring and lands in a bounded ring with trace/lower/compile
+  phase times — ``obs_bind_ms`` / ``obs_trace_ms`` histograms,
+  ``obs_compile_count`` counter. A 25-minute bind wedge is diagnosable
+  from ``report()``, not just from the bench harness.
+* **Utilization accounting** (:mod:`.mfu`): bound executors export
+  ``obs_mfu`` / ``obs_flops_per_sec`` gauges — analysis-cost-model FLOPs
+  x measured steps/s between report() calls.
+
+``report()`` is the one-call snapshot of all of it.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .. import profiler as _profiler
+from ..profiler import (span, spans_enabled, new_flow,            # noqa: F401
+                        register_thread_lane, Histogram, histogram,
+                        observe, counter_delta)
+from . import compiles
+from .compiles import scope as compile_scope                      # noqa: F401
+from .prometheus import render_prometheus, parse_prometheus       # noqa: F401
+from . import mfu
+from .mfu import peak_flops, register_executor                    # noqa: F401
+from .http import MetricsServer, start_metrics_server             # noqa: F401
+
+__all__ = [
+    "span", "spans_enabled", "new_flow", "register_thread_lane",
+    "Histogram", "histogram", "observe", "counter_delta",
+    "compile_scope", "compiles",
+    "render_prometheus", "parse_prometheus",
+    "mfu", "peak_flops", "register_executor",
+    "MetricsServer", "start_metrics_server",
+    "report",
+]
+
+# the jax.monitoring compile listener is the always-on layer: installed
+# at package import, zero cost outside compiles
+compiles.install()
+
+
+def report() -> Dict[str, Any]:
+    """One observability snapshot: per-executor utilization (this call
+    is the rate boundary — see :mod:`.mfu`), the compile ring, and the
+    ``obs_*`` counters/gauges/histogram summaries."""
+    executors = mfu.collect()
+    hist = {}
+    for name, h in _profiler.histograms().items():
+        if not name.startswith("obs_"):
+            continue
+        snap = h.snapshot()
+        hist[name] = {
+            "count": snap["count"],
+            "sum": round(snap["sum"], 3),
+            "max": snap["max"],
+            "p50": h.quantile(0.50),
+            "p99": h.quantile(0.99),
+        }
+    return {
+        "executors": executors,
+        "compiles": compiles.snapshot(),
+        "counters": {k: v for k, v in _profiler.counters().items()
+                     if k.startswith("obs_")},
+        "gauges": {k: v for k, v in _profiler.gauges().items()
+                   if k.startswith("obs_")},
+        "histograms": hist,
+    }

@@ -1,0 +1,133 @@
+"""Always-on compile accounting: who compiled what, and how long the
+trace / lower / backend-compile phases took.
+
+The round-5 bench wedged for 25 minutes inside a bind with nothing but a
+stderr breadcrumb to show for it — ``bind_secs`` lived only in
+``bench.py``. This module makes compile cost a framework observable:
+jax's :mod:`jax.monitoring` duration events
+(``/jax/core/compile/jaxpr_trace_duration``,
+``jaxpr_to_mlir_module_duration``, ``backend_compile_duration``) fire on
+the thread doing the compile, so a registered listener attributes them to
+whatever :class:`scope` that thread currently has open (the fused train
+step, an executor forward, a serve bucket, the fused optimizer step) at
+ZERO cost outside compiles — no per-step timers, no knobs, always on.
+
+Every executable build lands as one record in a bounded ring
+(``mx.obs.report()["compiles"]``) carrying the scope name + cache
+signature, and feeds the always-on aggregates:
+
+* counter ``obs_compile_count`` — executables built (persistent-cache
+  hits still count: they trace + lower + deserialize);
+* histograms ``obs_bind_ms`` (trace+lower+compile wall per executable)
+  and ``obs_trace_ms`` (trace phase alone);
+* counters ``obs_bind_ms_total`` / ``obs_trace_ms_total`` /
+  ``obs_compile_ms_total`` — integer-ms totals for rate math.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import profiler as _profiler
+
+__all__ = ["scope", "install", "snapshot", "RING_CAPACITY"]
+
+RING_CAPACITY = 256
+
+_ring: "collections.deque[Dict[str, Any]]" = \
+    collections.deque(maxlen=RING_CAPACITY)
+_ring_lock = threading.Lock()
+_tls = threading.local()
+_installed = False
+_t0 = time.perf_counter()
+
+
+class scope(object):
+    """Attribute compiles triggered inside the ``with`` body to
+    ``(name, signature)``. Nestable (innermost wins); costs two
+    thread-local writes, so hot paths keep it open around every dispatch
+    rather than trying to predict which call will compile."""
+
+    __slots__ = ("name", "signature", "_prev")
+
+    def __init__(self, name: str, signature: Any = None):
+        self.name = name
+        self.signature = signature
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "scope", None)
+        _tls.scope = (self.name, self.signature)
+        if self._prev is None:
+            # drop orphaned trace/lower seconds from an earlier attempt
+            # that never reached backend compile (a raising trace, an
+            # abstract eval) — they must not inflate THIS scope's first
+            # record. Nested scopes keep the accumulation: trace events
+            # of one executable all fire within one dispatch.
+            _tls.trace_s = 0.0
+            _tls.lower_s = 0.0
+        return self
+
+    def __exit__(self, *exc):
+        _tls.scope = self._prev
+        return False
+
+
+def _sig_str(sig: Any) -> Optional[str]:
+    if sig is None:
+        return None
+    s = repr(sig)
+    return s if len(s) <= 512 else s[:509] + "..."
+
+
+def _on_duration(name: str, dur: float, **_kw) -> None:
+    # runs on the compiling thread, between trace and execution — a few
+    # dict ops against a multi-second compile
+    if name == "/jax/core/compile/jaxpr_trace_duration":
+        _tls.trace_s = getattr(_tls, "trace_s", 0.0) + dur
+    elif name == "/jax/core/compile/jaxpr_to_mlir_module_duration":
+        _tls.lower_s = getattr(_tls, "lower_s", 0.0) + dur
+    elif name == "/jax/core/compile/backend_compile_duration":
+        trace_s = getattr(_tls, "trace_s", 0.0)
+        lower_s = getattr(_tls, "lower_s", 0.0)
+        _tls.trace_s = 0.0
+        _tls.lower_s = 0.0
+        sc = getattr(_tls, "scope", None)
+        trace_ms = trace_s * 1e3
+        bind_ms = (trace_s + lower_s + dur) * 1e3
+        rec = {
+            "scope": sc[0] if sc else None,
+            "signature": _sig_str(sc[1]) if sc else None,
+            "trace_ms": round(trace_ms, 3),
+            "lower_ms": round(lower_s * 1e3, 3),
+            "compile_ms": round(dur * 1e3, 3),
+            "bind_ms": round(bind_ms, 3),
+            "t_offset_s": round(time.perf_counter() - _t0, 3),
+            "thread": threading.current_thread().name,
+        }
+        with _ring_lock:
+            _ring.append(rec)
+        _profiler.incr_counter("obs_compile_count")
+        _profiler.incr_counter("obs_trace_ms_total", int(trace_ms))
+        _profiler.incr_counter("obs_compile_ms_total", int(dur * 1e3))
+        _profiler.incr_counter("obs_bind_ms_total", int(bind_ms))
+        _profiler.observe("obs_bind_ms", bind_ms)
+        _profiler.observe("obs_trace_ms", trace_ms)
+
+
+def install() -> None:
+    """Register the jax.monitoring listener (idempotent; called at
+    ``mx.obs`` import, i.e. package import — always on)."""
+    global _installed
+    if _installed:
+        return
+    import jax.monitoring
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _installed = True
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    """The compile ring, oldest first (bounded at RING_CAPACITY)."""
+    with _ring_lock:
+        return list(_ring)

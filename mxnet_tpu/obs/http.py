@@ -1,0 +1,95 @@
+"""Opt-in HTTP ``/metrics`` endpoint (Prometheus scrape target).
+
+A stdlib ``ThreadingHTTPServer`` on a daemon thread serving
+:func:`~mxnet_tpu.obs.prometheus.render_prometheus` — no dependencies,
+off by default. ``serve.InferenceServer`` auto-starts one when the
+``MXNET_TPU_OBS_METRICS_PORT`` knob (or its ``metrics_port=`` argument)
+says so; anything else can call :func:`start_metrics_server` directly.
+Binds 127.0.0.1 by default: exposing process metrics beyond the host is
+a deployment decision, not a framework default.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .prometheus import render_prometheus
+
+__all__ = ["MetricsServer", "start_metrics_server"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):                                      # noqa: N802
+        if self.path.split("?", 1)[0] != "/metrics":
+            self.send_error(404, "try /metrics")
+            return
+        try:
+            # a scrape is a log boundary: refresh the obs_mfu /
+            # obs_flops_per_sec gauges (one block on the last dispatched
+            # step per registered module — see mfu.collect)
+            from . import mfu as _mfu
+            _mfu.collect()
+        except Exception:                                  # noqa: BLE001
+            pass    # exposition must render even if a collector dies
+        body = render_prometheus().encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *_args):    # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer(object):
+    """Daemon-thread /metrics endpoint; ``port=0`` binds an ephemeral
+    port (read it back from ``.port``)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mxnet_tpu.obs[/metrics:%d]" % self.port, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return "http://%s:%d/metrics" % (self.host, self.port)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_metrics_server(port: int = 0,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    """Start (and return) a /metrics endpoint; caller owns ``close()``."""
+    return MetricsServer(port=port, host=host)
+
+
+def maybe_start_from_knob(explicit: Optional[int] = None) \
+        -> Optional[MetricsServer]:
+    """Endpoint policy shared by subsystems: an explicit ``metrics_port``
+    argument wins; None falls back to the ``MXNET_TPU_OBS_METRICS_PORT``
+    knob; a resolved value < 0 means off."""
+    port = explicit
+    if port is None:
+        from .. import config as _config
+        port = int(_config.get("MXNET_TPU_OBS_METRICS_PORT"))
+    if port is None or port < 0:
+        return None
+    return MetricsServer(port=port)

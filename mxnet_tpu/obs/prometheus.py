@@ -1,0 +1,144 @@
+"""Prometheus text-format exposition of the profiler registries.
+
+``render_prometheus()`` turns the always-on counters/gauges/histograms
+(:mod:`mxnet_tpu.profiler`) into the Prometheus text format
+(version 0.0.4): counters get a ``_total`` suffix, histograms emit the
+standard cumulative ``_bucket{le="..."}`` / ``_sum`` / ``_count`` triple
+(sparse: only buckets that hold observations, plus the mandatory
+``+Inf``). ``parse_prometheus()`` is the matching pure-Python grammar
+check the CI ``obs`` job and the tests run on the rendered text — no
+external scrape client needed to prove the exposition is well-formed.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Optional, Tuple
+
+from .. import profiler as _profiler
+
+__all__ = ["render_prometheus", "parse_prometheus"]
+
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(prefix: str, name: str) -> str:
+    n = _SANITIZE_RE.sub("_", str(name))
+    if n and n[0].isdigit():
+        n = "_" + n
+    return "%s_%s" % (prefix, n)
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if not math.isfinite(f):
+        # the text format's spellings — parse_prometheus round-trips them
+        return "NaN" if math.isnan(f) else ("+Inf" if f > 0 else "-Inf")
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(prefix: str = "mxnet_tpu") -> str:
+    """One scrape body over every registered counter, gauge and
+    histogram. Metric names are ``<prefix>_<sanitized registry key>``."""
+    lines = []
+    for name, v in sorted(_profiler.counters().items()):
+        m = _metric_name(prefix, name)
+        if not m.endswith("_total"):    # registry keys like
+            m += "_total"               # obs_bind_ms_total keep one suffix
+        lines.append("# TYPE %s counter" % m)
+        lines.append("%s %s" % (m, _fmt(v)))
+    for name, v in sorted(_profiler.gauges().items()):
+        m = _metric_name(prefix, name)
+        lines.append("# TYPE %s gauge" % m)
+        lines.append("%s %s" % (m, _fmt(v)))
+    for name, h in sorted(_profiler.histograms().items()):
+        snap = h.snapshot()
+        m = _metric_name(prefix, name)
+        lines.append("# TYPE %s histogram" % m)
+        cum = 0
+        for bound, c in zip(snap["bounds"], snap["counts"]):
+            cum += c
+            if c:
+                lines.append('%s_bucket{le="%.6g"} %d' % (m, bound, cum))
+        lines.append('%s_bucket{le="+Inf"} %d' % (m, snap["count"]))
+        lines.append("%s_sum %s" % (m, _fmt(snap["sum"])))
+        lines.append("%s_count %d" % (m, snap["count"]))
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------- grammar check
+
+_METRIC_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>%s)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?$" % _METRIC_RE)
+_LABEL_RE = re.compile(
+    r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"')
+_COMMENT_RE = re.compile(
+    r"^# (?:HELP %s .*|TYPE %s (?:counter|gauge|histogram|summary|"
+    r"untyped))$" % (_METRIC_RE, _METRIC_RE))
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    if s == "NaN":
+        return math.nan
+    return float(s)       # raises ValueError on garbage
+
+
+def parse_prometheus(text: str) \
+        -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Strict parse of a text-format exposition; raises ``ValueError``
+    on any malformed line. Returns ``{(metric, sorted label tuple):
+    value}`` so tests can assert on specific samples."""
+    samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not _COMMENT_RE.match(line):
+                raise ValueError(
+                    "line %d: malformed comment/metadata: %r"
+                    % (lineno, line))
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError("line %d: malformed sample: %r"
+                             % (lineno, line))
+        labels: Tuple[Tuple[str, str], ...] = ()
+        raw = m.group("labels")
+        if raw is not None:
+            pairs = []
+            rest = raw
+            while rest:
+                lm = _LABEL_RE.match(rest)
+                if lm is None:
+                    raise ValueError("line %d: malformed labels: %r"
+                                     % (lineno, raw))
+                pairs.append((lm.group("k"), lm.group("v")))
+                rest = rest[lm.end():]
+                if rest.startswith(","):
+                    rest = rest[1:]
+                elif rest:
+                    raise ValueError("line %d: malformed labels: %r"
+                                     % (lineno, raw))
+            labels = tuple(sorted(pairs))
+        try:
+            value = _parse_value(m.group("value"))
+        except ValueError:
+            raise ValueError("line %d: malformed value: %r"
+                             % (lineno, m.group("value")))
+        samples[(m.group("name"), labels)] = value
+    return samples
+
+
+def sample(samples, name: str, **labels) -> Optional[float]:
+    """Convenience lookup into :func:`parse_prometheus` output."""
+    return samples.get((name, tuple(sorted(labels.items()))))

@@ -1,10 +1,10 @@
-"""``mx.profiler`` — execution tracing.
+"""``mx.profiler`` — execution tracing, counters/gauges/histograms, spans.
 
 Reference: ``python/mxnet/profiler.py`` (profiler_set_config:27,
 profiler_set_state:48, dump_profile:64) writing the chrome://tracing JSON
 the engine emits in ``src/engine/profiler.cc:127-179``.
 
-Two layers here:
+Four layers here (docs/architecture/observability.md):
 
 * A framework-level event recorder: while the state is ``run``, every
   imperative op dispatch and every executor graph launch logs a
@@ -12,28 +12,46 @@ Two layers here:
   duration is real device time, the profiler twin of the reference's
   engine sync mode). ``dump_profile()`` writes the standard
   ``{"traceEvents": [...]}`` JSON loadable in chrome://tracing / Perfetto.
+* Structured **spans** with stable per-thread **lanes** and chrome-trace
+  **flow events**: subsystems wrap their pipeline stages in
+  ``span(name, flow=batch_id)`` so one batch's journey (prefetch →
+  device-place → fused-step dispatch → metric sync → checkpoint write;
+  serve: submit → coalesce → launch) renders as connected slices across
+  threads. Spans are recorded while the profiler runs OR while the
+  ``MXNET_TPU_OBS`` knob is on — otherwise ``span()`` returns a shared
+  no-op and allocates nothing (the ``obs_spans`` counter asserts that).
 * The XLA-level profiler: ``start_xla_trace(logdir)`` /
   ``stop_xla_trace()`` wrap ``jax.profiler`` for TensorBoard-grade HLO
   timelines on real hardware.
+* Counters/gauges/histograms: always-on, string-keyed, thread-safe —
+  used by subsystems to make their hot-path invariants assertable and
+  exported in Prometheus text format by :mod:`mxnet_tpu.obs`. The
+  checkpoint subsystem's family (docs/architecture/checkpoint.md):
+  ``ckpt_block_us`` vs ``ckpt_write_us``, ``ckpt_saved`` / ``ckpt_bytes``
+  / ``ckpt_save_async`` / ``ckpt_save_sync``, ``ckpt_backpressure_wait``,
+  ``ckpt_write_failed``, ``ckpt_load_ok`` / ``ckpt_load_fallback``,
+  ``ckpt_gc_removed``, ``ckpt_sigterm``, and gauges ``ckpt_queue_depth``,
+  ``ckpt_last_block_ms``, ``ckpt_last_write_ms``.
 
-Counters/gauges are a third, always-on layer (string-keyed, thread-safe)
-used by subsystems to make their hot-path invariants assertable. The
-checkpoint subsystem's family (docs/architecture/checkpoint.md):
-``ckpt_block_us`` (training-thread time spent in snapshot+submit — the
-number that must stay small) vs ``ckpt_write_us`` (background
-serialization+fsync time), ``ckpt_saved`` / ``ckpt_bytes`` /
-``ckpt_save_async`` / ``ckpt_save_sync``, ``ckpt_backpressure_wait``
-(writer queue was full at submit), ``ckpt_write_failed``,
-``ckpt_load_ok`` / ``ckpt_load_fallback`` (corrupt candidate skipped),
-``ckpt_gc_removed``, ``ckpt_sigterm``, and gauges ``ckpt_queue_depth``,
-``ckpt_last_block_ms``, ``ckpt_last_write_ms``.
+Concurrency contract: every mutation of module state (``_state``,
+``_filename``, events, counters, gauges, lanes, flow table) happens under
+``_lock``. The hot paths read two cached module booleans (``_tracing``,
+``_spans_on``) WITHOUT the lock as an early-out — a benign race whose
+worst case is one event recorded just after ``set_state("stop")`` or one
+skipped just after ``set_state("run")``; the authoritative append is
+under the lock, so the event list and the dumped payload are always
+internally consistent.
 """
 from __future__ import annotations
 
+import bisect
+import itertools
 import json
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+from . import config as _config
 
 __all__ = [
     "profiler_set_config", "profiler_set_state", "dump_profile",
@@ -42,6 +60,9 @@ __all__ = [
     "incr_counter", "get_counter", "counters", "reset_counters",
     "counter_delta",
     "set_gauge", "get_gauge", "gauges", "reset_gauges",
+    "span", "record_span", "spans_enabled", "new_flow",
+    "register_thread_lane",
+    "Histogram", "histogram", "observe", "histograms", "reset_histograms",
 ]
 
 _lock = threading.Lock()
@@ -50,6 +71,21 @@ _filename = "profile.json"
 _events: List[dict] = []
 _counters: dict = {}
 _t0 = time.perf_counter()
+
+# bound the in-memory trace: a long obs-on run must not grow without
+# limit; overflow is counted so a truncated dump is detectable
+_MAX_EVENTS = 1 << 20
+
+# cached fast-path flags (see the concurrency contract above)
+_tracing = False
+_spans_on = False
+
+
+def _recompute_enabled_locked() -> None:
+    """Refresh the cached fast-path flags; caller holds ``_lock``."""
+    global _tracing, _spans_on
+    _tracing = _state == "run"
+    _spans_on = _tracing or bool(_config.get("MXNET_TPU_OBS"))
 
 
 def state() -> str:
@@ -61,14 +97,17 @@ def set_config(filename: str = "profile.json", profile_all: bool = True,
     """(reference: profiler.py:27 profiler_set_config — mode knobs beyond
     the filename collapse: there is no per-subsystem engine here)."""
     global _filename
-    _filename = filename
+    with _lock:
+        _filename = filename
 
 
 def set_state(st: str = "stop") -> None:
     """'run' starts recording, 'stop' stops (reference: profiler.py:48)."""
     global _state
     assert st in ("run", "stop"), st
-    _state = st
+    with _lock:
+        _state = st
+        _recompute_enabled_locked()
 
 
 def pause() -> None:
@@ -79,18 +118,203 @@ def resume() -> None:
     set_state("run")
 
 
-def record_event(name: str, t_start: float, t_end: float,
-                 category: str = "op") -> None:
-    """Append one chrome-trace complete event (timestamps from
-    time.perf_counter())."""
-    if _state != "run":
-        return
+# --------------------------------------------------------------- lanes
+# A lane is one timeline track in the trace (a chrome ``tid``). Usually a
+# lane IS a thread (auto-registered under the thread's name on first
+# event), but a pipeline stage that shares a thread may claim its own
+# named lane (``span(..., lane="place")``) so its slices render on a
+# separate track — the tid is a registered small integer either way,
+# replacing the collision-prone ``tid % 100000`` of the original
+# recorder. The registry survives ``dump(finished=True)`` so lane ids
+# stay stable across dumps within one process.
+
+_lanes: Dict[str, int] = {}            # lane name -> small stable id
+_lane_counter = itertools.count(1)
+_tls = threading.local()
+
+
+def _lane_id_locked(name: str) -> int:
+    lid = _lanes.get(name)
+    if lid is None:
+        lid = next(_lane_counter)
+        _lanes[name] = lid
+    return lid
+
+
+def register_thread_lane(name: Optional[str] = None) -> int:
+    """Name the calling thread's trace lane (defaults to the thread
+    name); returns the stable lane id. Subsequent events from this thread
+    land on that lane. Re-registering under a new name moves the thread
+    to the (possibly fresh) lane."""
+    if name is None:
+        name = threading.current_thread().name
     with _lock:
-        _events.append({
-            "name": name, "cat": category, "ph": "X",
-            "ts": (t_start - _t0) * 1e6, "dur": (t_end - t_start) * 1e6,
-            "pid": 0, "tid": threading.get_ident() % 100000,
-        })
+        lid = _lane_id_locked(str(name))
+    _tls.lane = lid
+    return lid
+
+
+def _current_lane_locked() -> int:
+    lid = getattr(_tls, "lane", None)
+    if lid is None:
+        lid = _lane_id_locked(threading.current_thread().name)
+        _tls.lane = lid
+    return lid
+
+
+# --------------------------------------------------------------- flows
+# A flow id threads one logical unit of work (a batch, a request) through
+# spans on different lanes; the dump carries chrome flow events ("s"
+# start / "t" step) that Perfetto renders as arrows between the slices.
+
+_flow_counter = itertools.count(1)
+_flows_seen: Dict[int, bool] = {}
+_MAX_FLOWS = 8192
+
+
+def new_flow() -> int:
+    """Allocate a process-unique flow id (cheap, lock-free)."""
+    return next(_flow_counter)
+
+
+def _flow_event_locked(fid: int, ts_us: float, lane: int) -> dict:
+    if fid in _flows_seen:
+        ph = "t"
+    else:
+        ph = "s"
+        if len(_flows_seen) >= _MAX_FLOWS:
+            # drop the oldest half: a stale flow re-appearing emits a
+            # fresh "s" (one dangling arrow start, not a crash)
+            for k in list(_flows_seen)[:_MAX_FLOWS // 2]:
+                _flows_seen.pop(k, None)
+        _flows_seen[fid] = True
+    return {"name": "batch", "cat": "flow", "ph": ph, "id": int(fid),
+            "ts": ts_us, "pid": 0, "tid": lane, "bp": "e"}
+
+
+def record_event(name: str, t_start: float, t_end: float,
+                 category: str = "op", flow: Optional[int] = None,
+                 lane: Optional[str] = None) -> None:
+    """Append one chrome-trace complete event (timestamps from
+    time.perf_counter()). Recorded while the profiler state is ``run``
+    (op/graph events) — span events come in through :func:`span`, which
+    also records under ``MXNET_TPU_OBS``."""
+    if not _tracing:
+        return
+    _append_event(name, t_start, t_end, category, flow, lane)
+
+
+def _append_event(name, t_start, t_end, category, flow, lane,
+                  count_span: bool = False) -> None:
+    with _lock:
+        # authoritative re-check under the lock: a concurrent
+        # set_state("stop") + dump() must not observe a half-recorded
+        # tail growing behind the serialized payload
+        if not (_tracing or (count_span and _spans_on)):
+            return
+        if len(_events) >= _MAX_EVENTS:
+            _counters["profiler_events_dropped"] = \
+                _counters.get("profiler_events_dropped", 0) + 1
+            return
+        lid = _lane_id_locked(lane) if lane is not None \
+            else _current_lane_locked()
+        ts = (t_start - _t0) * 1e6
+        ev = {"name": name, "cat": category, "ph": "X", "ts": ts,
+              "dur": (t_end - t_start) * 1e6, "pid": 0, "tid": lid}
+        if flow is not None:
+            ev["args"] = {"flow": int(flow)}
+        _events.append(ev)
+        if flow is not None:
+            _events.append(_flow_event_locked(int(flow), ts, lid))
+        if count_span:
+            _counters["obs_spans"] = _counters.get("obs_spans", 0) + 1
+
+
+# --------------------------------------------------------------- spans
+
+
+def spans_enabled() -> bool:
+    """Fast, lock-free: True when span() currently records (profiler
+    running or ``MXNET_TPU_OBS`` on)."""
+    return _spans_on
+
+
+class _NoopSpan(object):
+    """Shared disabled-mode span: zero allocations per use."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def mark_flow(self, fid):
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span(object):
+    __slots__ = ("name", "category", "flow", "lane", "_t0")
+
+    def __init__(self, name, category, flow, lane):
+        self.name = name
+        self.category = category
+        self.flow = flow
+        self.lane = lane
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _append_event(self.name, self._t0, time.perf_counter(),
+                      self.category, self.flow, self.lane, count_span=True)
+        return False
+
+    def mark_flow(self, fid) -> None:
+        """Emit an extra flow step bound to this span's lane at the
+        current time (serve: one batch slice carries many request
+        flows)."""
+        if fid is None:
+            return
+        now = time.perf_counter()
+        with _lock:
+            if not _spans_on or len(_events) >= _MAX_EVENTS:
+                return
+            lid = _lane_id_locked(self.lane) if self.lane is not None \
+                else _current_lane_locked()
+            _events.append(_flow_event_locked(int(fid),
+                                              (now - _t0) * 1e6, lid))
+
+
+def record_span(name: str, t_start: float, t_end: float,
+                category: str = "span", flow: Optional[int] = None,
+                lane: Optional[str] = None) -> None:
+    """Low-level span record for sites that time conditionally (e.g. the
+    serve coalescer, which only emits when a batch actually formed).
+    Same gating as :func:`span`."""
+    if not _spans_on:
+        return
+    _append_event(name, t_start, t_end, category, flow, lane,
+                  count_span=True)
+
+
+def span(name: str, category: str = "span", flow: Optional[int] = None,
+         lane: Optional[str] = None):
+    """Context manager timing one pipeline stage into the trace.
+
+    ``flow`` links this slice to the other slices of the same batch or
+    request across lanes; ``lane`` overrides the thread's lane with a
+    named track. No-op (shared singleton, zero allocations) unless
+    :func:`spans_enabled`.
+    """
+    if not _spans_on:
+        return _NOOP_SPAN
+    return _Span(name, category, flow, lane)
 
 
 # ------------------------------------------------------------- counters
@@ -174,6 +398,144 @@ def reset_gauges() -> None:
         _gauges.clear()
 
 
+# ---------------------------------------------------------- histograms
+# Bounded distribution summaries on fixed log-spaced buckets: O(number of
+# buckets) memory at ANY observation volume, O(log buckets) record cost,
+# quantile estimates within one bucket (factor 2^0.25 ≈ 19%) of the true
+# percentile. The shared primitive behind serve latency percentiles and
+# the obs bind-time accounting; exported in Prometheus histogram format
+# by mx.obs.render_prometheus().
+
+# 96 log-spaced bounds, 1e-5 .. ~1.4e7 (units are the caller's: seconds
+# for latencies spans 10us..~160h, milliseconds for bind times spans
+# 10ns..~4h)
+_DEFAULT_BOUNDS = tuple(1e-5 * (2.0 ** (i / 4.0)) for i in range(96))
+
+
+class Histogram(object):
+    """Thread-safe fixed-bucket histogram (cumulative since last reset)."""
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_min", "_max",
+                 "_hlock")
+
+    def __init__(self, bounds=None):
+        self.bounds = tuple(float(b) for b in (bounds or _DEFAULT_BOUNDS))
+        assert all(a < b for a, b in zip(self.bounds, self.bounds[1:])), \
+            "histogram bounds must be strictly increasing"
+        # one overflow bucket past the last bound
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+        self._hlock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        idx = bisect.bisect_left(self.bounds, v)
+        with self._hlock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def reset(self) -> None:
+        with self._hlock:
+            for i in range(len(self._counts)):
+                self._counts[i] = 0
+            self._sum = 0.0
+            self._count = 0
+            self._min = None
+            self._max = None
+
+    def snapshot(self) -> dict:
+        """Consistent copy: {bounds, counts, sum, count, min, max}."""
+        with self._hlock:
+            return {"bounds": self.bounds, "counts": list(self._counts),
+                    "sum": self._sum, "count": self._count,
+                    "min": self._min, "max": self._max}
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0..1): linear interpolation inside the
+        bucket holding the target rank; None while empty. Off by at most
+        one bucket from the exact order statistic."""
+        snap = self.snapshot()
+        return _snapshot_quantile(snap, q)
+
+    def quantiles(self, qs) -> List[Optional[float]]:
+        snap = self.snapshot()
+        return [_snapshot_quantile(snap, q) for q in qs]
+
+
+def _snapshot_quantile(snap: dict, q: float) -> Optional[float]:
+    count = snap["count"]
+    if count == 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    target = q * count
+    bounds, counts = snap["bounds"], snap["counts"]
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        prev_cum = cum
+        cum += c
+        if cum >= target:
+            lo = bounds[i - 1] if i > 0 else max(
+                0.0, snap["min"] if snap["min"] is not None else 0.0)
+            hi = bounds[i] if i < len(bounds) else \
+                (snap["max"] if snap["max"] is not None else bounds[-1])
+            lo = max(lo, snap["min"]) if snap["min"] is not None else lo
+            hi = min(hi, snap["max"]) if snap["max"] is not None else hi
+            if hi <= lo:
+                return lo
+            frac = (target - prev_cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+    return snap["max"]
+
+
+_histograms: Dict[str, Histogram] = {}
+
+
+def histogram(name: str, bounds=None) -> Histogram:
+    """Get-or-create the registry histogram ``name`` (shared across the
+    process, like counters/gauges)."""
+    with _lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = Histogram(bounds)
+            _histograms[name] = h
+        return h
+
+
+def observe(name: str, value: float) -> None:
+    """Record one observation into the registry histogram ``name``."""
+    histogram(name).observe(value)
+
+
+def histograms() -> Dict[str, Histogram]:
+    """Snapshot of the histogram registry (name -> Histogram)."""
+    with _lock:
+        return dict(_histograms)
+
+
+def reset_histograms() -> None:
+    with _lock:
+        for h in _histograms.values():
+            h.reset()
+
+
 class record(object):
     """Context manager: time a region into the profile."""
 
@@ -191,18 +553,45 @@ class record(object):
         return False
 
 
+# serializes the file write of dump() without holding the hot-path
+# _lock across disk I/O: two concurrent dump() calls to one filename
+# must not interleave their buffered writes into unparseable JSON
+_dump_lock = threading.Lock()
+
+
 def dump(finished: bool = True) -> str:
     """Write the chrome-trace JSON; returns the path (reference:
     profiler.py:64 dump_profile -> engine Profiler::DumpProfile,
-    src/engine/profiler.cc:127-179)."""
+    src/engine/profiler.cc:127-179). The payload AND the target filename
+    are captured under the lock (so a concurrent ``set_config`` swaps
+    cleanly between dumps), and the write itself is serialized under a
+    separate dump lock (so concurrent dumps cannot interleave)."""
+    with _dump_lock:
+        return _dump_locked(finished)
+
+
+def _dump_locked(finished: bool) -> str:
     with _lock:
-        payload = {"traceEvents": list(_events),
-                   "displayTimeUnit": "ms"}
+        events = list(_events)
+        # lane-name metadata first (only for lanes that actually appear)
+        # so every used tid renders under its registered name
+        used = {e.get("tid") for e in events}
+        meta = []
+        for name, lid in sorted(_lanes.items(), key=lambda kv: kv[1]):
+            if lid not in used:
+                continue
+            meta.append({"name": "thread_name", "ph": "M", "pid": 0,
+                         "tid": lid, "args": {"name": name}})
+            meta.append({"name": "thread_sort_index", "ph": "M", "pid": 0,
+                         "tid": lid, "args": {"sort_index": lid}})
+        payload = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        path = _filename
         if finished:
             _events.clear()
-    with open(_filename, "w") as f:
+            _flows_seen.clear()
+    with open(path, "w") as f:
         json.dump(payload, f)
-    return _filename
+    return path
 
 
 # reference-compatible names
@@ -223,3 +612,14 @@ def start_xla_trace(logdir: str) -> None:
 def stop_xla_trace() -> None:
     import jax
     jax.profiler.stop_trace()
+
+
+# keep the cached span flag honest under runtime knob flips
+def _on_obs_knob(_value) -> None:
+    with _lock:
+        _recompute_enabled_locked()
+
+
+_config.on_change("MXNET_TPU_OBS", _on_obs_knob)
+with _lock:
+    _recompute_enabled_locked()

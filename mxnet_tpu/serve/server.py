@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 import weakref
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -42,6 +43,8 @@ import numpy as np
 
 from .. import ndarray as nd_mod
 from .. import profiler as _profiler
+from ..obs import compiles as _obs_compiles
+from ..obs.http import maybe_start_from_knob as _maybe_metrics
 from .._fused import CompileCache, structural_failure
 from ..base import MXNetError
 from ..context import Context, current_context
@@ -183,10 +186,10 @@ def _serve_loop(server_ref):
 
 class _Request:
     __slots__ = ("data", "rows", "batched", "sample_shape", "bucket_key",
-                 "future", "t_submit", "deadline")
+                 "future", "t_submit", "deadline", "flow")
 
     def __init__(self, data, rows, batched, sample_shape, bucket_key,
-                 deadline):
+                 deadline, flow=None):
         self.data = data
         self.rows = rows
         self.batched = batched
@@ -195,6 +198,7 @@ class _Request:
         self.future: Future = Future()
         self.t_submit = monotonic()
         self.deadline = deadline
+        self.flow = flow    # trace flow id linking submit -> launch
 
 
 class InferenceServer:
@@ -218,6 +222,11 @@ class InferenceServer:
     name : str
         Prefix for profiler counters/gauges (default ``"serve"``; give
         each server a distinct name to split dashboards).
+    metrics_port : int, optional
+        Opt-in Prometheus ``/metrics`` endpoint (mx.obs exposition):
+        ``None`` defers to the ``MXNET_TPU_OBS_METRICS_PORT`` knob,
+        ``-1`` = off, ``0`` = ephemeral port (read ``.metrics_port``
+        back), ``>0`` = fixed port. Closed with the server.
     """
 
     def __init__(self, model, max_batch_size: Optional[int] = None,
@@ -225,7 +234,8 @@ class InferenceServer:
                  queue_bound: Optional[int] = None,
                  buckets: Optional[BucketSpec] = None,
                  ctx: Optional[Context] = None,
-                 name: str = "serve"):
+                 name: str = "serve",
+                 metrics_port: Optional[int] = None):
         from .. import config as _config
         if buckets is not None and max_batch_size is not None:
             raise ValueError("pass max_batch_size or buckets, not both")
@@ -252,7 +262,29 @@ class InferenceServer:
         grid = self.buckets.executable_bound()
         self.cache = CompileCache(
             name, max_entries=max(4 * grid, 128) if grid else 4096)
-        self.latency = LatencyStats()
+        # latency rides the shared obs histogram registry (same-name
+        # servers aggregate, mirroring the <name>_* counter discipline)
+        # so the Prometheus exposition includes it without extra wiring
+        self.latency = LatencyStats(name=name + "_latency_seconds")
+        # opt-in Prometheus /metrics endpoint (arg wins over the
+        # MXNET_TPU_OBS_METRICS_PORT knob; resolved < 0 = off). This
+        # server is deliberately collectable without close() (the worker
+        # holds only a weakref) — the finalizer keeps that true for the
+        # endpoint too, releasing the bound port when the server is GC'd
+        try:
+            self._metrics = _maybe_metrics(metrics_port)
+        except OSError as exc:
+            # an observability knob must never take down the serving
+            # path: a port conflict (second server on a fixed port,
+            # another process) degrades to no endpoint, loudly
+            import logging
+            logging.getLogger(__name__).warning(
+                "serve[%s]: /metrics endpoint disabled (%s)", name, exc)
+            _profiler.incr_counter(name + "_metrics_bind_failed")
+            self._metrics = None
+        self.metrics_port = self._metrics.port if self._metrics else None
+        self._metrics_finalizer = weakref.finalize(
+            self, self._metrics.close) if self._metrics else None
         # serializes ALL model invocations: Predictor/Module adapters
         # mutate shared executor state (arg_dict -> forward -> outputs),
         # so a kill-switch eager call in a caller thread must never
@@ -320,19 +352,22 @@ class InferenceServer:
             # thread — no queue, no batching, no bucketing
             return self._eager_future(x, rows, batched)
 
-        req = _Request(x, rows, batched, sample_shape, bucket_key, deadline)
-        with self._cond:
-            if self._closed:
-                raise ServerClosed("submit() after close()")
-            if len(self._queue) >= self.queue_bound:
-                _profiler.incr_counter(self.name + "_shed")
-                raise QueueFull(
-                    "queue depth %d at admission bound %d"
-                    % (len(self._queue), self.queue_bound))
-            self._queue.append(req)
-            _profiler.set_gauge(self.name + "_queue_depth",
-                                len(self._queue))
-            self._cond.notify_all()
+        fid = _profiler.new_flow() if _profiler.spans_enabled() else None
+        req = _Request(x, rows, batched, sample_shape, bucket_key, deadline,
+                       flow=fid)
+        with _profiler.span("serve_submit", "serve", flow=fid):
+            with self._cond:
+                if self._closed:
+                    raise ServerClosed("submit() after close()")
+                if len(self._queue) >= self.queue_bound:
+                    _profiler.incr_counter(self.name + "_shed")
+                    raise QueueFull(
+                        "queue depth %d at admission bound %d"
+                        % (len(self._queue), self.queue_bound))
+                self._queue.append(req)
+                _profiler.set_gauge(self.name + "_queue_depth",
+                                    len(self._queue))
+                self._cond.notify_all()
         return req.future
 
     def __call__(self, data, batched: bool = False,
@@ -362,6 +397,9 @@ class InferenceServer:
         for req in dropped:
             _resolve(req.future, exc=ServerClosed("server closed"))
         self._worker.join(timeout)
+        if self._metrics_finalizer is not None:
+            self._metrics_finalizer()    # idempotent: detaches after one call
+            self._metrics = None
 
     def __enter__(self):
         return self
@@ -416,6 +454,8 @@ class InferenceServer:
                 if not self._queue:
                     return None if self._closed else []
             head = self._queue[0]
+            _t_co = time.perf_counter() if _profiler.spans_enabled() \
+                else None
             window_end = head.t_submit + self.max_delay_s
             while not self._closed:
                 now = monotonic()
@@ -465,6 +505,12 @@ class InferenceServer:
             _resolve(req.future, exc=DeadlineExceeded(
                 "deadline passed %.1f ms before batch launch"
                 % ((now - req.deadline) * 1e3)))
+        if batch and _t_co is not None:
+            # batching-window slice on the batcher lane, linked to the
+            # head request's flow (idle ticks emit nothing)
+            _profiler.record_span("serve_coalesce", _t_co,
+                                  time.perf_counter(), "serve",
+                                  flow=batch[0].flow)
         return batch
 
     def _compatible_rows(self, bucket_key) -> int:
@@ -524,7 +570,12 @@ class InferenceServer:
             if fresh:
                 runner = self._call_model
             try:
-                outs = runner(nd_mod.array(buf, ctx=self._ctx))
+                with _profiler.span("serve_launch", "serve",
+                                    flow=batch[0].flow) as _sp:
+                    for req in batch[1:]:
+                        _sp.mark_flow(req.flow)
+                    with _obs_compiles.scope(self.name, sig):
+                        outs = runner(nd_mod.array(buf, ctx=self._ctx))
             except Exception as exc:                       # noqa: BLE001
                 self.cache.mark_failed(sig,
                                        permanent=structural_failure(exc))
@@ -561,16 +612,18 @@ class InferenceServer:
         done = monotonic()
         r0 = 0
         try:
-            for req in batch:
-                if self._single_output:
-                    res = outs[0][r0:r0 + req.rows] if req.batched \
-                        else outs[0][r0]
-                else:
-                    res = [o[r0:r0 + req.rows] if req.batched else o[r0]
-                           for o in outs]
-                r0 += req.rows
-                self.latency.record(done - req.t_submit)
-                _resolve(req.future, res)
+            with _profiler.span("serve_resolve", "serve",
+                                flow=batch[0].flow):
+                for req in batch:
+                    if self._single_output:
+                        res = outs[0][r0:r0 + req.rows] if req.batched \
+                            else outs[0][r0]
+                    else:
+                        res = [o[r0:r0 + req.rows] if req.batched else o[r0]
+                               for o in outs]
+                    r0 += req.rows
+                    self.latency.record(done - req.t_submit)
+                    _resolve(req.future, res)
         except Exception as exc:                           # noqa: BLE001
             # row-contract violation (output leading axis != input rows):
             # every future must still resolve — a dead batcher thread

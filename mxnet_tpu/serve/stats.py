@@ -1,61 +1,74 @@
 """Serving observability: latency distribution + throughput accounting.
 
-Latencies land in a bounded ring (recent-window reservoir, the same
-bounded-memory discipline as CompileCache) so a long-lived server's
-``stats()`` reflects current behavior, not its lifetime average, and
-memory stays O(capacity) at any request volume. Percentiles are computed
-on snapshot, not on record — the submit path stays O(1) under the lock.
+Latencies land in the shared bounded histogram primitive
+(:class:`mxnet_tpu.profiler.Histogram` — fixed log-spaced buckets,
+factor ``2^0.25`` so quantile estimates stay within one bucket (≤19%) of
+the exact order statistic, parity-tested against ``numpy.percentile`` in
+``tests/test_obs.py``). That replaces the previous private sample ring:
+memory is O(buckets) at any request volume, ``record`` is O(log buckets)
+under a per-histogram lock, and because the histogram lives in the
+profiler registry under ``<server name>_latency_seconds`` it shows up in
+the Prometheus exposition (``mx.obs.render_prometheus()`` / the serve
+``/metrics`` endpoint) for free — same-name servers aggregate, exactly
+like the ``<name>_*`` serve counters always have.
+
+Percentiles are computed on snapshot, not on record — the submit path
+stays O(1)-ish under the lock. ``reset()`` drops the accumulated
+distribution (e.g. after warmup, so compile-time latencies don't pollute
+steady-state percentiles); unlike the old fixed-capacity ring there is
+no sliding window, so long-lived servers should reset at rollup
+boundaries if they want recent-behavior percentiles.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
-import numpy as np
+from .. import profiler as _profiler
 
 __all__ = ["LatencyStats"]
 
 
 class LatencyStats:
-    """Thread-safe bounded reservoir of per-request latencies (seconds)."""
+    """Thread-safe latency distribution (seconds) over the shared
+    registry histogram ``name``."""
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096,
+                 name: str = "serve_latency_seconds"):
+        # ``capacity`` survives for API compatibility with the old
+        # sample-ring; boundedness now comes from the fixed bucket grid
         self.capacity = int(capacity)
-        self._ring = np.zeros(self.capacity, np.float64)
-        self._n = 0            # total recorded (monotonic)
-        self._lock = threading.Lock()
+        self.name = name
+        self._hist = _profiler.histogram(name)
 
     def record(self, seconds: float) -> None:
-        with self._lock:
-            self._ring[self._n % self.capacity] = seconds
-            self._n += 1
+        self._hist.observe(seconds)
 
     @property
     def count(self) -> int:
-        return self._n
+        return self._hist.count
 
     def reset(self) -> None:
-        """Drop the retained window (e.g. after warmup, so compile-time
-        latencies don't pollute steady-state percentiles)."""
-        with self._lock:
-            self._n = 0
+        """Drop the retained distribution (e.g. after warmup, so
+        compile-time latencies don't pollute steady-state percentiles)."""
+        self._hist.reset()
 
     def snapshot(self) -> Optional[Dict[str, float]]:
-        """{p50, p95, p99, mean, max, window} in milliseconds over the
-        retained window; None before the first request."""
-        with self._lock:
-            n = min(self._n, self.capacity)
-            if n == 0:
-                return None
-            window = self._ring[:n].copy()
-        p50, p95, p99 = np.percentile(window, [50.0, 95.0, 99.0])
+        """{p50, p95, p99, mean, max, window} in milliseconds since the
+        last reset; None before the first request."""
+        snap = self._hist.snapshot()
+        n = snap["count"]
+        if n == 0:
+            return None
+        p50, p95, p99 = (
+            _profiler._snapshot_quantile(snap, q)
+            for q in (0.50, 0.95, 0.99))
         return {
             "p50_ms": round(float(p50) * 1e3, 4),
             "p95_ms": round(float(p95) * 1e3, 4),
             "p99_ms": round(float(p99) * 1e3, 4),
-            "mean_ms": round(float(window.mean()) * 1e3, 4),
-            "max_ms": round(float(window.max()) * 1e3, 4),
+            "mean_ms": round(snap["sum"] / n * 1e3, 4),
+            "max_ms": round(float(snap["max"]) * 1e3, 4),
             "window": int(n),
         }
 

@@ -1,0 +1,147 @@
+"""CI ``obs`` job: trace-schema + exposition validation and the
+disabled-mode overhead gate (ISSUE 6 satellite).
+
+Three checks, all pure Python, no external scrapers or viewers:
+
+1. **Trace schema** — a short async ``fit`` with ``MXNET_TPU_OBS=1``
+   must dump a Perfetto-loadable ``{"traceEvents": [...]}`` with >= 4
+   distinct named lanes and at least one batch flow id linking >= 3
+   lanes (prefetch -> device-place -> train/metric).
+2. **Exposition** — ``mx.obs.render_prometheus()`` must pass the strict
+   pure-Python text-format grammar check (``parse_prometheus``), and the
+   always-on compile telemetry (obs_compile_count / obs_bind_ms) must be
+   populated by the fit's binds.
+3. **Disabled-mode overhead gate** — a subprocess with ``MXNET_TPU_OBS``
+   off runs the same fixed-step fused loop and must record ZERO span
+   allocations (``obs_spans`` counter — deterministic, the principled
+   gate: disabled span() returns a shared no-op). The enabled subprocess
+   must stay within a generous noise band of the disabled one (CI boxes
+   are noisy; the 1%-class claim is measured on quiet hardware by
+   tools/perf/fit_loop_bench.py comparisons, not here).
+
+Exit code 0 = all gates passed.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = """
+import json, os, sys, time
+sys.path.insert(0, %(root)r)
+import numpy as np
+import mxnet_tpu as mx
+
+mod_sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+    mx.sym.Variable("data"), num_hidden=32, name="fc1"), name="softmax")
+mod = mx.mod.Module(mod_sym, context=mx.cpu())
+mod.bind(data_shapes=[("data", (16, 8))],
+         label_shapes=[("softmax_label", (16,))])
+mod.init_params(mx.init.Xavier())
+mod.init_optimizer(optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.1})
+rng = np.random.RandomState(0)
+db = mx.io.DataBatch(data=[mx.nd.array(rng.rand(16, 8).astype(np.float32))],
+                     label=[mx.nd.array(np.zeros((16,), np.float32))])
+import jax
+from mxnet_tpu import profiler as _profiler
+for _ in range(3):
+    mod._fit_step(db)
+jax.block_until_ready(mod._step_token())
+with _profiler.counter_delta() as d:
+    n = 300
+    t0 = time.perf_counter()
+    for i in range(n):
+        # the per-batch span exactly as fit()'s hot loop carries it:
+        # disabled mode must make this a shared no-op (zero allocations)
+        with _profiler.span("fused_step_dispatch", "step", flow=i):
+            mod._fit_step(db)
+    jax.block_until_ready(mod._step_token())
+    dt = time.perf_counter() - t0
+print(json.dumps({"steps_per_sec": n / dt, "spans": d.get("obs_spans")}))
+"""
+
+
+def _run_child(obs_on: bool) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TPU_OBS"] = "1" if obs_on else "0"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD % {"root": root}],
+        env=env, stdout=subprocess.PIPE, text=True, timeout=300, check=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def check_trace_and_exposition() -> None:
+    import numpy as np
+    import mxnet_tpu as mx
+
+    mx.config.set("MXNET_TPU_OBS", 1)
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (160, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=8, name="fc1"), name="softmax")
+    with tempfile.TemporaryDirectory() as td:
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.fit(it, optimizer="sgd", initializer=mx.init.Xavier(),
+                optimizer_params={"learning_rate": 0.1}, num_epoch=2,
+                checkpoint=mx.checkpoint.CheckpointConfig(
+                    os.path.join(td, "ck"), every_n_batches=5))
+        mx.config.set("MXNET_TPU_OBS", 0)
+        path = os.path.join(td, "trace.json")
+        mx.profiler.set_config(filename=path)
+        mx.profiler.dump()
+        with open(path) as f:
+            trace = json.load(f)
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events, "empty trace"
+    lanes = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert len(lanes) >= 4, "expected >=4 named lanes, got %s" % lanes
+    flow_lanes = {}
+    for e in events:
+        if e.get("cat") == "flow":
+            flow_lanes.setdefault(e["id"], set()).add(e["tid"])
+    assert any(len(v) >= 3 for v in flow_lanes.values()), \
+        "no flow id crossed >=3 lanes"
+    print("obs_smoke: trace OK — lanes=%s flows=%d"
+          % (sorted(lanes), len(flow_lanes)))
+
+    text = mx.obs.render_prometheus()
+    samples = mx.obs.parse_prometheus(text)
+    assert samples, "empty exposition"
+    assert ("mxnet_tpu_obs_compile_count_total", ()) in samples, \
+        "compile telemetry missing from exposition"
+    assert mx.obs.histogram("obs_bind_ms").count > 0, \
+        "obs_bind_ms histogram never populated"
+    print("obs_smoke: exposition OK — %d samples parse" % len(samples))
+
+
+def check_disabled_overhead() -> None:
+    off = _run_child(obs_on=False)
+    on = _run_child(obs_on=True)
+    print("obs_smoke: steps/s off=%.1f on=%.1f, off-mode spans=%d"
+          % (off["steps_per_sec"], on["steps_per_sec"], off["spans"]))
+    assert off["spans"] == 0, \
+        "disabled mode allocated %d spans" % off["spans"]
+    assert on["spans"] > 0, "enabled mode recorded no spans"
+    # generous CI noise band; the deterministic gate is the zero-span
+    # assert above
+    assert on["steps_per_sec"] >= 0.5 * off["steps_per_sec"], \
+        "enabled-mode overhead out of band"
+
+
+def main() -> None:
+    check_trace_and_exposition()
+    check_disabled_overhead()
+    print("obs_smoke: ALL OK")
+
+
+if __name__ == "__main__":
+    main()
