@@ -1,43 +1,72 @@
-"""``mxnet_tpu.analysis`` — static graph/program analysis.
+"""``mxnet_tpu.analysis`` — static graph/program/efficiency analysis.
 
-Three analyzers over the two-language design (ISSUE 3; see
-``docs/architecture/analysis.md``):
+Analyzers over the two-language design (ISSUE 3 + ISSUE 8; see
+``docs/architecture/analysis.md``), all sharing one
+Finding/Report/Severity vocabulary:
 
 * :func:`analyze_symbol` — graph passes over ``Symbol`` DAGs run pre-bind
-  (cycle / dup-name / dead-node / shape-error / cost-model). Also exposed
-  as ``Symbol.analyze()`` and ``Module.analyze()``.
+  (cycle / dup-name / dead-node / shape-error / cost-model), now joined
+  by the **memory passes** (``remat-opportunity`` ranking long-lived
+  cheap-to-recompute activations with concrete ``jax.checkpoint``
+  policy suggestions, and the enforceable ``hbm-budget`` —
+  ``MXNET_TPU_ANALYZE_HBM_BUDGET``). Also exposed as
+  ``Symbol.analyze()`` and ``Module.analyze()``.
 * :func:`analyze_program` — jaxpr hazard checks run post-trace
-  (baked-const / f64-promotion / host-callback / donation).
+  (baked-const / f64-promotion / host-callback / donation);
+  :func:`analyze_program_memory` — hierarchical jaxpr liveness
+  (activation high-water per program, the metric remat suggestions
+  move).
+* :mod:`.sharding_passes` — spec audits against a mesh
+  (``spec-axis``/``spec-rank``/``reshard-thrash``/``fsdp-opportunity``)
+  and the post-partitioning HLO collective walk with the static
+  comm-bytes/link-time cost model (``Report.extras["comm"]``).
+* :mod:`.roofline` — compiled-cost (``compiled.cost_analysis()``) vs
+  the analysis FLOP model (``flop-model-drift``), compute- vs
+  memory-bound classification, and the ``mx.obs.report()``
+  reconciliation that puts a "why" next to every ``obs_mfu`` number.
 * :func:`lint_paths` — AST concurrency/perf lint for the codebase itself
-  (lock-host-sync / lock-dispatch / wall-clock), with inline
-  ``# mx-lint: allow(code)`` suppressions and a CI baseline.
+  (lock-host-sync / lock-dispatch / wall-clock / eager-loop-sync /
+  signal-unsafe), with inline ``# mx-lint: allow(code)`` suppressions
+  and a CI baseline that fails on drift in either direction.
 
 Bind-time enforcement rides the ``MXNET_TPU_ANALYZE=off|warn|strict`` knob
 (:func:`check_bind`, called from ``Executor.__init__``): ``warn`` logs
-WARNING+ findings, ``strict`` raises ``MXNetError`` on ERROR findings.
-The knob defaults to ``off`` and the Executor hook imports this package
-lazily, so analysis is strictly zero-cost when disabled (asserted by
-``tests/test_analysis.py::test_analyze_off_is_zero_cost``).
+WARNING+ findings, ``strict`` raises ``MXNetError`` on ERROR findings —
+including an over-``MXNET_TPU_ANALYZE_HBM_BUDGET`` bind, rejected before
+any trace or compile. The knob defaults to ``off`` and the Executor hook
+imports this package lazily, so analysis is strictly zero-cost when
+disabled (asserted by ``tests/test_analysis.py::test_analyze_off_is_zero_cost``).
 
 Every finding increments an always-on profiler counter for its hazard
 class (``analysis_<code>``), so hazard rates are observable fleet-wide
 without holding Report objects.
 
-CLI: ``python -m mxnet_tpu.analysis {graph,lint,self-check} ...``.
+CLI: ``python -m mxnet_tpu.analysis {graph,lint,audit,self-check} ...``.
 """
 from __future__ import annotations
 
 from .findings import Finding, Report, Severity
 from .graph_passes import GRAPH_PASSES, analyze_symbol
+# importing memory_passes registers remat-opportunity + hbm-budget into
+# GRAPH_PASSES (after the cost model they read)
+from .memory_passes import analyze_program_memory, parse_bytes
 from .program_passes import analyze_jaxpr, analyze_program
 from .lint import (baseline_key, diff_baseline, lint_paths, lint_source,
-                   load_baseline, write_baseline)
+                   load_baseline, stale_baseline, write_baseline)
+from . import memory_passes, roofline, sharding_passes
+from .sharding_passes import (analyze_collectives, analyze_module_sharding,
+                              check_islands, check_replicated, check_specs)
 
 __all__ = [
     "Finding", "Report", "Severity",
     "analyze_symbol", "analyze_program", "analyze_jaxpr",
+    "analyze_program_memory", "parse_bytes",
+    "analyze_collectives", "analyze_module_sharding",
+    "check_specs", "check_islands", "check_replicated",
+    "memory_passes", "sharding_passes", "roofline",
     "lint_paths", "lint_source",
-    "load_baseline", "write_baseline", "diff_baseline", "baseline_key",
+    "load_baseline", "write_baseline", "diff_baseline", "stale_baseline",
+    "baseline_key",
     "check_bind", "GRAPH_PASSES",
 ]
 
@@ -45,9 +74,10 @@ __all__ = [
 def check_bind(symbol, input_shapes=None, input_dtypes=None,
                mode: str = "warn", context: str = "bind") -> Report:
     """The bind-time verification hook (``MXNET_TPU_ANALYZE``): run the
-    graph passes with the bind's shapes and enforce the mode contract —
-    ``warn`` logs, ``strict`` raises on ERROR findings. Returns the Report
-    so callers (tests, tools) can inspect what fired."""
+    graph passes (structural + cost + memory/budget) with the bind's
+    shapes and enforce the mode contract — ``warn`` logs, ``strict``
+    raises on ERROR findings. Returns the Report so callers (tests,
+    tools) can inspect what fired."""
     report = analyze_symbol(symbol, input_shapes=input_shapes,
                             input_dtypes=input_dtypes, context=context)
     return report.enforce(mode)

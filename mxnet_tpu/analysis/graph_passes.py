@@ -295,6 +295,25 @@ def _nelem(shape) -> int:
     return int(np.prod(shape)) if shape else 1
 
 
+def _attr_bool(v) -> bool:
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def _attr_tuple(v):
+    """Attr values are python tuples from the symbol API but strings
+    after a JSON round-trip."""
+    if isinstance(v, str):
+        try:
+            v = _pyast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return None
+    if v is None:
+        return None
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v),)
+
+
 def _node_flops(node, in_avals, out_avals) -> int:
     """Static FLOP estimate; default one flop per output element
     (elementwise), with explicit rules for the contraction-heavy ops."""
@@ -305,10 +324,35 @@ def _node_flops(node, in_avals, out_avals) -> int:
         if name == "FullyConnected" and len(in_avals) >= 2:
             k = in_avals[1][0][-1]                 # weight (nh, K)
             return 2 * _nelem(out_avals[0][0]) * int(k)
-        if name in ("Convolution", "Convolution_v1", "Deconvolution") \
-                and len(in_avals) >= 2:
-            w = in_avals[1][0]                     # (nf, cin/g, *kernel)
+        if name in ("Convolution", "Convolution_v1") and len(in_avals) >= 2:
+            # weight (nf, cin/g, *kernel): each output element needs
+            # cin/g * prod(kernel) MACs, so grouped/depthwise conv is
+            # priced correctly through the weight shape itself
+            w = in_avals[1][0]
             return 2 * _nelem(out_avals[0][0]) * _nelem(w[1:])
+        if name == "Deconvolution" and len(in_avals) >= 2:
+            # weight (cin, nf/g, *kernel) — NOT the conv layout; pricing
+            # through w[1:] would charge nf/g where the contraction depth
+            # is cin/g (wrong whenever cin != nf)
+            w = in_avals[1][0]
+            g = int(a.get("num_group", 1) or 1)
+            return 2 * _nelem(out_avals[0][0]) * (int(w[0]) // g) \
+                * _nelem(w[2:])
+        if name in ("Pooling", "Pooling_v1"):
+            # one compare/add per window element per output element (the
+            # per-element fallback undercounted by prod(kernel) — the
+            # same shape as the PR 6 flash-attention fix); avg adds one
+            # divide per output element
+            in_shape = in_avals[0][0]
+            if _attr_bool(a.get("global_pool")):
+                kernel = in_shape[2:]
+            else:
+                kernel = _attr_tuple(a.get("kernel")) or ()
+            out_elems0 = _nelem(out_avals[0][0])
+            flops = out_elems0 * max(1, _nelem(kernel))
+            if str(a.get("pool_type", "max")) == "avg":
+                flops += out_elems0
+            return flops
         if name in ("dot", "batch_dot", "linalg_gemm2"):
             k = in_avals[0][0][-1]
             return 2 * _nelem(out_avals[0][0]) * int(k)

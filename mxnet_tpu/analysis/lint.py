@@ -25,10 +25,21 @@ in the AST without running anything:
   metric log-boundary fetch) and the ``InflightWindow`` flow-control
   waits live outside loop-function bodies by construction.
 
+* ``signal-unsafe`` (ERROR/WARNING) — lock acquisition or
+  allocation-heavy calls lexically inside a **registered signal
+  handler** (a function installed via ``signal.signal``): a signal can
+  land while the interrupted frame already holds the very lock the
+  handler wants (logging's module lock, a profiler counter lock, the
+  GIL-guarded allocator arenas), deadlocking the process — the hazard
+  class the PR 5 SIGTERM handler dodges by hand by setting ONE flag and
+  returning (checkpoint/manager.py ``install_sigterm``).
+
 Intentional sites are suppressed inline with ``# mx-lint: allow(<code>)``
 (on the offending line or the enclosing ``with`` line); historical debt is
 carried by a checked-in baseline (:func:`load_baseline`/:func:`diff_baseline`)
-so CI fails only on NEW findings.
+so CI fails only on NEW findings — and :func:`stale_baseline` reports
+suppressions the code no longer needs, which the CI gate treats as
+findings too (a baseline that only grows is a baseline nobody trusts).
 """
 from __future__ import annotations
 
@@ -41,7 +52,7 @@ from typing import Dict, List, Optional, Tuple
 from .findings import Finding, Report, Severity
 
 __all__ = ["lint_paths", "lint_source", "load_baseline", "write_baseline",
-           "diff_baseline", "baseline_key"]
+           "diff_baseline", "stale_baseline", "baseline_key"]
 
 _LOCK_NAME = re.compile(r"(lock|cond|mutex|sem)", re.IGNORECASE)
 _ALLOW = re.compile(r"#\s*mx-lint:\s*allow\(([\w\s,-]+)\)")
@@ -198,6 +209,108 @@ class _FileLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# methods that take a lock / block — fatal if the interrupted frame
+# already holds the other side (python's own signal docs: handlers must
+# be reentrant). The first set is unambiguous; the second is flagged
+# only when the receiver's name looks synchronization-flavored
+# (str.join / dict.get would otherwise drown the rule in noise).
+_SIGNAL_LOCKING_METHODS = {"acquire", "notify", "notify_all"}
+_SIGNAL_BLOCKING_METHODS = {"wait", "join", "put", "get", "set"}
+_SIGNAL_SYNC_RECEIVER = re.compile(
+    r"(lock|cond|mutex|sem|queue|thread|event)", re.IGNORECASE)
+# call roots that allocate heavily or take module-level locks (logging's
+# handler lock is the classic signal deadlock)
+_SIGNAL_HEAVY_ROOTS = {"logging", "jax", "jnp", "np", "numpy", "nd",
+                       "print", "open"}
+
+
+class _SignalScanner:
+    """Second pass: find functions registered via ``signal.signal`` and
+    flag lock-taking / allocation-heavy calls lexically inside them.
+    Registration-by-name is resolved within the file (plain names AND
+    ``self._handler``-style attributes, both common in this codebase)."""
+
+    def __init__(self, path: str, source: str, report: Report):
+        self.path = path
+        self.lines = source.splitlines()
+        self.report = report
+
+    def _allowed(self, line: int) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        m = _ALLOW.search(self.lines[line - 1])
+        return bool(m and "signal-unsafe" in
+                    [c.strip() for c in m.group(1).split(",")])
+
+    def scan(self, tree: ast.AST) -> None:
+        defs: Dict[str, ast.AST] = {}
+        handlers: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+            elif isinstance(node, ast.Call) and \
+                    _dotted(node.func) in ("signal.signal",
+                                           "signal.sigaction") and \
+                    len(node.args) >= 2:
+                target = node.args[1]
+                if isinstance(target, ast.Lambda):
+                    handlers.append((target, "<lambda>"))
+                elif isinstance(target, ast.Name):
+                    handlers.append((target.id, target.id))
+                elif isinstance(target, ast.Attribute):
+                    handlers.append((target.attr, target.attr))
+        seen = set()
+        for target, name in handlers:
+            node = defs.get(target) if isinstance(target, str) else target
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            self._scan_handler(node, name)
+
+    def _scan_handler(self, fn: ast.AST, name: str) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if _is_lock_expr(item.context_expr):
+                        self._add(
+                            Severity.ERROR, name,
+                            "acquires lock %r" % _dotted(item.context_expr),
+                            item.context_expr.lineno)
+            elif isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                leaf = dotted.rsplit(".", 1)[-1]
+                root = dotted.split(".", 1)[0]
+                receiver = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+                if leaf in _SIGNAL_LOCKING_METHODS and "." in dotted:
+                    self._add(
+                        Severity.ERROR, name,
+                        "calls %s() — takes a lock/blocks" % dotted,
+                        node.lineno)
+                elif leaf in _SIGNAL_BLOCKING_METHODS and \
+                        _SIGNAL_SYNC_RECEIVER.search(receiver):
+                    self._add(
+                        Severity.ERROR, name,
+                        "calls %s() — takes a lock/blocks" % dotted,
+                        node.lineno)
+                elif root in _SIGNAL_HEAVY_ROOTS:
+                    self._add(
+                        Severity.WARNING, name,
+                        "calls %s() — allocation-heavy / takes module "
+                        "locks" % dotted, node.lineno)
+
+    def _add(self, severity: Severity, handler: str, what: str,
+             line: int) -> None:
+        if self._allowed(line):
+            return
+        self.report.add(
+            "signal-unsafe", severity,
+            "registered signal handler %r %s: a signal can land while "
+            "the interrupted frame holds the other side and deadlock "
+            "the process — handlers must only set a flag (the PR 5 "
+            "install_sigterm discipline)" % (handler, what),
+            path=self.path, line=line, func=handler)
+
+
 def lint_source(source: str, path: str = "<string>",
                 report: Optional[Report] = None) -> Report:
     report = report if report is not None else Report(context="lint")
@@ -209,6 +322,7 @@ def lint_source(source: str, path: str = "<string>",
                    line=exc.lineno or 0)
         return report
     _FileLinter(path, source, report).visit(tree)
+    _SignalScanner(path, source, report).scan(tree)
     return report
 
 
@@ -262,10 +376,11 @@ def write_baseline(report: Report, path: str, root: str) -> int:
     (several same-key findings collapse into one counted key)."""
     payload = {
         "__doc__": "mx-lint baseline: known findings keyed by "
-                   "path::code::function with counts; CI fails only when "
-                   "a key's count exceeds its baseline. Regenerate with "
-                   "`python -m mxnet_tpu.analysis lint <paths> "
-                   "--write-baseline <file>`.",
+                   "path::code::function with counts; CI fails on drift "
+                   "in EITHER direction — a count exceeding its baseline "
+                   "(new finding) or a baseline exceeding the count "
+                   "(stale suppression). Regenerate with "
+                   "`python -m mxnet_tpu.analysis lint --update-baseline`.",
         "findings": _key_counts(report, root),
     }
     with open(path, "w") as fh:
@@ -278,6 +393,18 @@ def load_baseline(path: str) -> Dict[str, int]:
     with open(path) as fh:
         payload = json.load(fh)
     return {k: int(v) for k, v in payload.get("findings", {}).items()}
+
+
+def stale_baseline(report: Report, baseline: Dict[str, int],
+                   root: str) -> Dict[str, int]:
+    """Baseline keys whose counted debt the code no longer carries
+    (key -> excess). Stale suppressions are findings too: they mask the
+    next REAL finding introduced at that key, so the CI gate fails on
+    drift in *either* direction and the fix is
+    ``python -m mxnet_tpu.analysis lint --update-baseline``."""
+    counts = _key_counts(report, root)
+    return {k: v - counts.get(k, 0) for k, v in sorted(baseline.items())
+            if v > counts.get(k, 0)}
 
 
 def diff_baseline(report: Report, baseline: Dict[str, int],

@@ -1,0 +1,179 @@
+"""Roofline/utilization pass: why is MFU what it is?
+
+PR 6 made ``obs_mfu`` always-on; this pass attaches the *why*. Two FLOP
+accountings are reconciled per executable:
+
+* the **analysis cost model** (static, per-node — what ``obs_mfu``
+  multiplies by steps/s), and
+* XLA's own **compiled cost** (``compiled.cost_analysis()`` — FLOPs and
+  bytes the scheduler actually planned, post-fusion/partitioning).
+
+When the two disagree beyond tolerance the model is lying
+(``flop-model-drift`` — the exact undercount shape the PR 6
+flash-attention fix repaired), and every MFU number derived from it
+inherits the lie. Each program is then classified against the device
+roofline: arithmetic intensity (FLOPs / bytes accessed) vs the device
+balance point (peak FLOP/s / HBM bandwidth). A memory-bound program's
+attainable MFU is ``intensity / balance`` — if measured ``obs_mfu`` is
+already there, the gap is the roofline, not scheduling, and the fix is
+more intensity (bigger batch, fusion, remat); if measured MFU is far
+below attainable, the gap IS scheduling (input stalls, host syncs,
+compile churn) and the async-loop counters are the next place to look.
+
+Peak FLOP/s comes from the one table in :mod:`mxnet_tpu.obs.mfu`; HBM
+bandwidth from the table here (override: ``MXNET_TPU_ANALYZE_HBM_GBPS``
+— required on CPU test rigs where the device kind is unknown).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .findings import Report, Severity
+
+__all__ = ["classify", "analyze_executable", "explain", "hbm_gbps",
+           "HBM_GBPS_BY_DEVICE_KIND", "FLOP_MODEL_DRIFT_TOL"]
+
+# HBM bandwidth (GB/s) by TPU generation, device_kind substring match —
+# the denominator of the balance point. Sibling of
+# obs.mfu.PEAK_FLOPS_BY_DEVICE_KIND (peak FLOP/s stays single-sourced
+# there).
+HBM_GBPS_BY_DEVICE_KIND = [
+    ("v5 lite", 819.0), ("v5e", 819.0), ("v5p", 2765.0),
+    ("v6", 1640.0), ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0)]
+
+# |compiled/model - 1| beyond this is a drift finding
+FLOP_MODEL_DRIFT_TOL = 0.25
+
+
+def hbm_gbps(device_kind: Optional[str] = None) -> Optional[float]:
+    """HBM GB/s: the ``MXNET_TPU_ANALYZE_HBM_GBPS`` override wins, else
+    the device-kind table; None when unknown (classification is then
+    skipped, never fabricated)."""
+    from .sharding_passes import device_table_lookup
+    return device_table_lookup(HBM_GBPS_BY_DEVICE_KIND,
+                               "MXNET_TPU_ANALYZE_HBM_GBPS",
+                               default=None, device_kind=device_kind)
+
+
+def classify(flops: float, bytes_accessed: float,
+             device_kind: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Roofline classification of one program: arithmetic intensity vs
+    the device balance point. None when peak/bandwidth are unknown."""
+    from ..obs import mfu as _mfu
+    peak = _mfu.peak_flops(device_kind)
+    bw = hbm_gbps(device_kind)
+    if not peak or not bw or not flops or not bytes_accessed:
+        return None
+    intensity = flops / bytes_accessed
+    balance = peak / (bw * 1e9)
+    attainable = min(1.0, intensity / balance)
+    return {
+        "intensity_flops_per_byte": round(intensity, 3),
+        "balance_flops_per_byte": round(balance, 3),
+        "bound": "compute" if intensity >= balance else "memory",
+        "attainable_mfu": round(attainable, 4),
+        "peak_flops": peak,
+        "hbm_gbps": bw,
+    }
+
+
+def analyze_executable(fn, *args, model_flops: Optional[float] = None,
+                       in_shardings=None, static_argnums=(),
+                       context: str = "roofline",
+                       report: Optional[Report] = None,
+                       **kwargs) -> Report:
+    """Compile ``fn(*args)`` and reconcile XLA's cost with the model.
+
+    ``Report.extras["roofline"]``: compiled FLOPs / bytes accessed /
+    XLA's own temp (activation) bytes from ``memory_analysis()``, the
+    classification, and — when ``model_flops`` is given (the analysis
+    cost model's count for the same program) — the model/compiled ratio,
+    with a ``flop-model-drift`` WARNING beyond ±25%. Compiled counts are
+    **per device** after partitioning; the caller's ``model_flops`` must
+    be per-device too (divide the whole-program count by the mesh size).
+    """
+    import jax
+
+    report = report if report is not None else Report(context=context)
+    jit_kw: Dict[str, Any] = {"static_argnums": static_argnums}
+    if in_shardings is not None:
+        jit_kw["in_shardings"] = in_shardings
+    compiled = jax.jit(fn, **jit_kw).lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops") or 0)
+    nbytes = float(ca.get("bytes accessed") or 0)
+    roof: Dict[str, Any] = {
+        "compiled_flops": flops,
+        "compiled_bytes_accessed": nbytes,
+    }
+    try:
+        mem = compiled.memory_analysis()
+        roof["xla_temp_bytes"] = int(getattr(mem, "temp_size_in_bytes", 0))
+        roof["xla_argument_bytes"] = int(
+            getattr(mem, "argument_size_in_bytes", 0))
+        roof["xla_output_bytes"] = int(
+            getattr(mem, "output_size_in_bytes", 0))
+    except Exception:                                       # noqa: BLE001
+        pass
+    cls = classify(flops, nbytes)
+    if cls:
+        roof.update(cls)
+        report.add(
+            "roofline", Severity.INFO,
+            "%s-bound: intensity %.3g FLOP/byte vs balance %.3g — "
+            "attainable MFU %.2f (%.3g GFLOP, %.3g MB accessed)"
+            % (cls["bound"], cls["intensity_flops_per_byte"],
+               cls["balance_flops_per_byte"], cls["attainable_mfu"],
+               flops / 1e9, nbytes / 1e6),
+            detail=dict(roof))
+    if model_flops:
+        ratio = flops / model_flops if model_flops else float("inf")
+        roof["model_flops"] = float(model_flops)
+        roof["model_ratio"] = round(ratio, 4)
+        if abs(ratio - 1.0) > FLOP_MODEL_DRIFT_TOL and flops:
+            report.add(
+                "flop-model-drift", Severity.WARNING,
+                "analysis FLOP model says %.4g but XLA compiled-cost says "
+                "%.4g (ratio %.2f) — the model is mis-counting this "
+                "program's ops (the PR 6 flash-attention undercount "
+                "shape) and obs_mfu inherits the error; fix the "
+                "_node_flops rule for the dominant op"
+                % (model_flops, flops, ratio),
+                detail={"model_flops": float(model_flops),
+                        "compiled_flops": flops, "ratio": ratio})
+    report.extras["roofline"] = roof
+    return report
+
+
+def explain(flops: float, bytes_moved: float,
+            measured_mfu: Optional[float] = None) -> Optional[Dict[str, Any]]:
+    """The ``mx.obs.report()`` reconciliation: classify a program from
+    its static cost-model counts and, given the measured ``obs_mfu``,
+    say which side of the gap to attack. Returns None when the device
+    roofline is unknown."""
+    cls = classify(flops, bytes_moved)
+    if cls is None:
+        return None
+    if measured_mfu is not None:
+        attainable = cls["attainable_mfu"]
+        cls["measured_mfu"] = round(measured_mfu, 4)
+        if attainable > 0:
+            cls["roofline_fraction"] = round(measured_mfu / attainable, 3)
+        if cls["bound"] == "memory" and measured_mfu >= 0.8 * attainable:
+            cls["why"] = ("memory-bound at the roofline: measured MFU "
+                          "%.2f of attainable %.2f — raise intensity "
+                          "(bigger batch / remat / fusion), not "
+                          "scheduling" % (measured_mfu, attainable))
+        elif measured_mfu < 0.5 * attainable:
+            cls["why"] = ("well below the %s roofline (measured %.2f vs "
+                          "attainable %.2f) — the gap is scheduling: "
+                          "check loop_* counters for input stalls, host "
+                          "syncs, recompiles"
+                          % (cls["bound"], measured_mfu, attainable))
+        else:
+            cls["why"] = ("approaching the %s roofline (measured %.2f "
+                          "vs attainable %.2f)"
+                          % (cls["bound"], measured_mfu, attainable))
+    return cls

@@ -1,0 +1,524 @@
+"""Sharding/communication passes: spec audits + a static comm cost model.
+
+ROADMAP item 1's risk is spending a 6000-chip bill to discover a bad
+layout; these passes make layouts auditable at bind time on the 8-device
+virtual mesh. Three layers:
+
+* **Spec audits** (no tracing): :func:`check_specs` validates a
+  ``name -> PartitionSpec`` map against a mesh and the array shapes
+  (unknown axes, over-ranked specs, non-dividing dims);
+  :func:`check_islands` compares the *separate sharding islands*
+  (``parallel/{mesh,dist,moe,pipeline,ring_attention}.py`` each declare
+  their canonical specs via ``parallel.sharding_islands()``) for the two
+  cross-island hazards — an axis an island partitions over that the
+  bound mesh does not carry, and the same logical array declared with
+  different layouts in different islands (**resharding thrash**: every
+  boundary crossing pays an all-to-all);
+  :func:`check_replicated` flags large fully-replicated parameters as
+  FSDP opportunities with the bytes a sharded layout recovers per
+  device.
+* **Collective walk** (:func:`analyze_collectives`): jit + lower +
+  compile the program against its shardings, then walk the
+  post-partitioning HLO for ``all-reduce`` / ``all-gather`` /
+  ``reduce-scatter`` / ``all-to-all`` / ``collective-permute`` ops,
+  attribute each to its mesh axis by matching ``replica_groups`` against
+  the axis subgroups, and apply the static ring-cost model
+  (:func:`comm_link_bytes`) with the ICI bandwidth table to estimate
+  per-axis link time. ``Report.extras["comm"]`` is the machine-readable
+  table; the acceptance test hand-computes one known collective's bytes
+  against it.
+* **Module audit** (:func:`analyze_module_sharding`): all of the above
+  for a mesh-bound ``Module`` — specs resolved exactly as the bind path
+  resolves them (``Module._sharding_for``), the program being the bound
+  executor's forward.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .findings import Report, Severity
+
+__all__ = ["check_specs", "check_islands", "check_replicated",
+           "analyze_collectives", "analyze_module_sharding",
+           "collectives_from_hlo", "comm_link_bytes",
+           "device_table_lookup",
+           "FSDP_MIN_BYTES", "ICI_GBPS_BY_DEVICE_KIND"]
+
+# replicated params smaller than this are not worth sharding (the
+# all-gather latency beats the HBM savings)
+FSDP_MIN_BYTES = 1 << 20            # 1 MiB
+
+# per-link ICI bandwidth (GB/s, one direction) by TPU generation — the
+# static cost model's time axis. A model, not a measurement: good enough
+# to rank layouts and spot an axis that moves 100x the bytes of another.
+ICI_GBPS_BY_DEVICE_KIND = [
+    ("v5p", 100.0), ("v5 lite", 50.0), ("v5e", 50.0),
+    ("v6", 100.0), ("v4", 50.0), ("v3", 70.0), ("v2", 70.0)]
+_DEFAULT_ICI_GBPS = 50.0
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?P<start>-start)?\(")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+# XLA's iota (V2) format: replica_groups=[2,4]<=[8] or ...<=[4,2]T(1,0)
+_GROUPS_V2_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _spec_parts(spec) -> List[Any]:
+    """PartitionSpec -> list of per-dim entries (None | axis | tuple)."""
+    if spec is None:
+        return []
+    return list(spec)
+
+
+def _spec_axes(spec) -> List[str]:
+    axes: List[str] = []
+    for part in _spec_parts(spec):
+        if part is None:
+            continue
+        axes.extend(part if isinstance(part, (tuple, list)) else [part])
+    return axes
+
+
+# ------------------------------------------------------------- spec audits
+
+
+def check_specs(mesh, specs: Dict[str, Any],
+                shapes: Optional[Dict[str, Sequence[int]]] = None,
+                report: Optional[Report] = None,
+                context: str = "sharding") -> Report:
+    """Validate ``name -> PartitionSpec`` against ``mesh`` (+shapes).
+
+    * ``spec-axis`` (ERROR) — a spec partitions over an axis the mesh
+      does not carry: GSPMD rejects it at trace time on the big job;
+      here it is a finding at audit time.
+    * ``spec-rank`` (ERROR) — spec has more entries than the array has
+      dims.
+    * ``spec-divisibility`` (WARNING) — the axis size does not divide
+      the dim: XLA pads every shard (wasted HBM + compute on the pad).
+    * ``spec-duplicate-axis`` (ERROR) — one axis partitions two dims of
+      the same array (invalid).
+    """
+    report = report if report is not None else Report(context=context)
+    mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape)) \
+        if mesh is not None else {}
+    for name, spec in sorted(specs.items()):
+        parts = _spec_parts(spec)
+        axes = _spec_axes(spec)
+        for ax in axes:
+            if mesh_axes and ax not in mesh_axes:
+                report.add(
+                    "spec-axis", Severity.ERROR,
+                    "spec %s for %r partitions over axis %r but the mesh "
+                    "carries only %s — GSPMD would reject this at trace "
+                    "time" % (spec, name, ax, sorted(mesh_axes)),
+                    node=name)
+        dup = {a for a in axes if axes.count(a) > 1}
+        if dup:
+            report.add(
+                "spec-duplicate-axis", Severity.ERROR,
+                "spec %s for %r uses axis(es) %s on more than one dim — "
+                "a mesh axis can partition at most one dim of an array"
+                % (spec, name, sorted(dup)), node=name)
+        shape = tuple((shapes or {}).get(name) or ())
+        if not shape:
+            continue
+        if len(parts) > len(shape):
+            report.add(
+                "spec-rank", Severity.ERROR,
+                "spec %s has %d entries but %r has rank %d (shape %s)"
+                % (spec, len(parts), name, len(shape), list(shape)),
+                node=name)
+            continue
+        for dim, part in enumerate(parts):
+            if part is None:
+                continue
+            size = 1
+            for ax in (part if isinstance(part, (tuple, list)) else [part]):
+                size *= mesh_axes.get(ax, 1)
+            if size > 1 and shape[dim] % size:
+                report.add(
+                    "spec-divisibility", Severity.WARNING,
+                    "dim %d of %r (%d) is not divisible by the %s "
+                    "partitioning (%d shards) — every shard is padded"
+                    % (dim, name, shape[dim], part, size), node=name)
+    return report
+
+
+def check_islands(islands: Dict[str, Dict[str, Any]], mesh=None,
+                  shapes: Optional[Dict[str, Sequence[int]]] = None,
+                  report: Optional[Report] = None,
+                  context: str = "sharding") -> Report:
+    """Cross-island audit: the same logical array declared with different
+    layouts in different islands is **resharding thrash** — every
+    boundary crossing lowers to an all-to-all/all-gather pair. With a
+    mesh, each island's axes are also checked for existence (the
+    currently-separate ``parallel/*`` islands each assume their own axis
+    name; a unified layout must carry all of them or drop the island).
+    """
+    report = report if report is not None else Report(context=context)
+    mesh_axes = set(mesh.axis_names) if mesh is not None else None
+    by_name: Dict[str, List[Tuple[str, Any]]] = {}
+    for island, specs in sorted(islands.items()):
+        for name, spec in sorted(specs.items()):
+            by_name.setdefault(name, []).append((island, spec))
+            if mesh_axes is None:
+                continue
+            missing = [ax for ax in _spec_axes(spec) if ax not in mesh_axes]
+            if missing:
+                report.add(
+                    "spec-axis", Severity.WARNING,
+                    "island %r shards %r over axis(es) %s which the bound "
+                    "mesh (%s) does not carry — its collectives silently "
+                    "degrade to no-ops or fail at trace time; unify the "
+                    "layout (ROADMAP item 1) or extend the mesh"
+                    % (island, name, missing, ", ".join(sorted(mesh_axes))),
+                    node=name, detail={"island": island,
+                                       "missing_axes": missing})
+    for name, entries in sorted(by_name.items()):
+        layouts = {}
+        for island, spec in entries:
+            layouts.setdefault(str(spec), []).append(island)
+        if len(layouts) < 2:
+            continue
+        shape = tuple((shapes or {}).get(name) or ())
+        nbytes = int(np.prod(shape, dtype=np.int64)) * 4 if shape else 0
+        report.add(
+            "reshard-thrash", Severity.WARNING,
+            "%r is bounced between layouts: %s — each boundary crossing "
+            "reshards the whole array%s; pick ONE spec for it across "
+            "islands" % (
+                name,
+                "; ".join("%s in %s" % (s, "/".join(isl))
+                          for s, isl in sorted(layouts.items())),
+                " (~%.3g MB moved per crossing)" % (nbytes / 1e6)
+                if nbytes else ""),
+            node=name,
+            detail={"layouts": {s: isl for s, isl in layouts.items()},
+                    "bytes": nbytes})
+    return report
+
+
+def check_replicated(mesh, specs: Dict[str, Any],
+                     shapes: Dict[str, Sequence[int]],
+                     dtypes: Optional[Dict[str, Any]] = None,
+                     report: Optional[Report] = None,
+                     min_bytes: int = FSDP_MIN_BYTES,
+                     context: str = "sharding") -> Report:
+    """Large fully-replicated parameters are FSDP opportunities: every
+    device holds all N bytes where a sharded layout holds N/devices and
+    all-gathers on use. Fires ``fsdp-opportunity`` (WARNING) with the
+    estimated bytes recovered per device."""
+    report = report if report is not None else Report(context=context)
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    if n_dev < 2:
+        return report
+    for name in sorted(shapes):
+        shape = tuple(shapes[name])
+        if not shape:
+            continue
+        if _spec_axes(specs.get(name)):
+            continue                      # already partitioned
+        itemsize = np.dtype((dtypes or {}).get(name, np.float32)).itemsize
+        nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize
+        if nbytes < min_bytes:
+            continue
+        recovered = nbytes * (n_dev - 1) // n_dev
+        report.add(
+            "fsdp-opportunity", Severity.WARNING,
+            "%r (%.3g MB) is fully replicated across %d devices — "
+            "sharding it (FSDP / ZeRO-style, largest dim over the data "
+            "axis) recovers ~%.3g MB of HBM per device at the cost of an "
+            "all-gather on use"
+            % (name, nbytes / 1e6, n_dev, recovered / 1e6),
+            node=name,
+            detail={"bytes": nbytes, "recovered_bytes_per_device":
+                    int(recovered), "devices": n_dev})
+    return report
+
+
+# -------------------------------------------------------- collective walk
+
+
+def _axis_groups(mesh) -> Dict[frozenset, Tuple[str, ...]]:
+    """Map replica-group sets -> mesh axis subsets. For every non-empty
+    subset of axes, the groups are the device-id sets that vary over
+    those axes with the others fixed (the groups GSPMD emits)."""
+    import itertools
+    names = list(mesh.axis_names)
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    out: Dict[frozenset, Tuple[str, ...]] = {}
+    # descending subset size so the SMALLEST subset wins a collision —
+    # on a mesh with a size-1 axis ({"data": 1, "model": 8}) the
+    # ('model',) and ('data','model') groups are identical, and the
+    # per-axis table must report the one users grep for ('model')
+    for r in range(len(names), 0, -1):
+        for combo in itertools.combinations(range(len(names)), r):
+            keep = [i for i in range(len(names)) if i not in combo]
+            perm = ids.transpose(keep + list(combo))
+            flat = perm.reshape(-1, int(np.prod(
+                [ids.shape[i] for i in combo], dtype=np.int64)))
+            groups = frozenset(frozenset(int(x) for x in row)
+                               for row in flat)
+            out[groups] = tuple(names[i] for i in combo)
+    return out
+
+
+def _shape_bytes(shape_str: str, largest_only: bool = False) -> int:
+    """Bytes of an HLO shape string (``f32[16,32]{1,0}`` or a tuple
+    ``(f32[4], f32[4])``). ``largest_only`` takes the biggest single
+    array instead of the sum — async ``*-start`` forms return an
+    (operand-alias, result[, context...]) tuple where only the result
+    buffer actually moves; summing would double-count."""
+    sizes = []
+    for dt, dims in _SHAPE_RE.findall(shape_str):
+        width = re.search(r"(\d+)$", dt)
+        itemsize = max(1, int(width.group(1)) // 8) if width else 4
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * itemsize)
+    if not sizes:
+        return 0
+    return max(sizes) if largest_only else sum(sizes)
+
+
+def comm_link_bytes(kind: str, nbytes: int, group_size: int) -> int:
+    """Bytes crossing the busiest link for one collective over a ring of
+    ``group_size`` devices moving an ``nbytes`` buffer (the standard
+    ring-algorithm counts; the model behind the per-axis time
+    estimates)."""
+    n = max(1, int(group_size))
+    if n == 1:
+        return 0
+    if kind == "all-reduce":
+        return int(2 * nbytes * (n - 1) / n)
+    if kind in ("all-gather", "reduce-scatter", "all-to-all"):
+        return int(nbytes * (n - 1) / n)
+    if kind == "collective-permute":
+        return int(nbytes)
+    return int(nbytes)
+
+
+def device_table_lookup(table, override_knob: str, default=None,
+                        device_kind: Optional[str] = None):
+    """The shared knob-then-device-kind ladder every bandwidth/peak
+    table uses: a positive config override wins, else the first
+    substring match of the (probed) ``device_kind`` in ``table``, else
+    ``default``. One implementation so a new TPU generation is added in
+    the tables, not in N copies of the lookup."""
+    from .. import config as _config
+    override = float(_config.get(override_knob))
+    if override > 0:
+        return override
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:                                   # noqa: BLE001
+            device_kind = ""
+    dk = (device_kind or "").lower()
+    for sub, val in table:
+        if sub in dk:
+            return val
+    return default
+
+
+def ici_gbps(device_kind: Optional[str] = None) -> float:
+    return device_table_lookup(ICI_GBPS_BY_DEVICE_KIND,
+                               "MXNET_TPU_ANALYZE_ICI_GBPS",
+                               default=_DEFAULT_ICI_GBPS,
+                               device_kind=device_kind)
+
+
+def collectives_from_hlo(hlo_text: str, mesh=None) -> List[Dict[str, Any]]:
+    """Parse post-partitioning HLO for collectives; one record per op
+    with kind, per-shard buffer bytes, replica-group size and — when the
+    groups match a mesh axis subset — the axis attribution."""
+    groups_map = _axis_groups(mesh) if mesh is not None else {}
+    records: List[Dict[str, Any]] = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        kind = m.group("kind")
+        nbytes = _shape_bytes(m.group("shape"),
+                              largest_only=bool(m.group("start")))
+        gm = _GROUPS_RE.search(line)
+        gm2 = _GROUPS_V2_RE.search(line)
+        group_size = 1
+        axes: Tuple[str, ...] = ()
+        groups = None
+        if gm:
+            groups = frozenset(
+                frozenset(int(x) for x in g.split(",") if x.strip())
+                for g in re.findall(r"\{([^}]*)\}", gm.group(1)))
+        elif gm2:
+            # iota form [G,S]<=[dims]T(perm): device ids are
+            # iota(prod(dims)).reshape(dims).transpose(perm) flattened
+            # into G groups of S
+            g_n, g_s = int(gm2.group(1)), int(gm2.group(2))
+            dims = [int(d) for d in gm2.group(3).split(",")]
+            ids = np.arange(int(np.prod(dims))).reshape(dims)
+            if gm2.group(4):
+                ids = ids.transpose([int(p)
+                                     for p in gm2.group(4).split(",")])
+            ids = ids.reshape(g_n, g_s)
+            groups = frozenset(frozenset(int(x) for x in row)
+                               for row in ids)
+        if groups is not None:
+            group_size = max((len(g) for g in groups), default=1)
+            axes = groups_map.get(groups, ())
+        elif kind == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            if pm:
+                pairs = re.findall(r"\{(\d+),(\d+)\}", pm.group(0))
+                group_size = len({int(a) for a, _ in pairs}) or 1
+        records.append({
+            "kind": kind, "bytes": nbytes, "group_size": group_size,
+            "axes": list(axes),
+            "link_bytes": comm_link_bytes(kind, nbytes, group_size),
+        })
+    return records
+
+
+def analyze_collectives(fn, *args, mesh=None, in_shardings=None,
+                        out_shardings=None, static_argnums=(),
+                        context: str = "collectives",
+                        report: Optional[Report] = None,
+                        **kwargs) -> Report:
+    """Compile ``fn`` against its shardings and cost its collectives.
+
+    ``args`` may be committed (already-sharded) arrays — jit then infers
+    the input layouts — or plain arrays with explicit ``in_shardings``.
+    ``Report.extras["comm"]``:
+
+    * ``collectives`` — every collective with bytes/axis/link cost;
+    * ``per_axis`` — aggregated buffer bytes, link bytes and the
+      ring-model time estimate per mesh axis (the number the acceptance
+      test hand-checks);
+    * ``total_link_bytes`` / ``est_total_us``.
+    """
+    import jax
+
+    report = report if report is not None else Report(context=context)
+    jit_kw: Dict[str, Any] = {"static_argnums": static_argnums}
+    if in_shardings is not None:
+        jit_kw["in_shardings"] = in_shardings
+    if out_shardings is not None:
+        jit_kw["out_shardings"] = out_shardings
+    compiled = jax.jit(fn, **jit_kw).lower(*args, **kwargs).compile()
+    records = collectives_from_hlo(compiled.as_text(), mesh=mesh)
+    bw = ici_gbps() * 1e9
+    per_axis: Dict[str, Dict[str, float]] = {}
+    total_link = 0
+    for rec in records:
+        rec["est_us"] = rec["link_bytes"] / bw * 1e6
+        key = "x".join(rec["axes"]) if rec["axes"] else "<unattributed>"
+        agg = per_axis.setdefault(key, {"bytes": 0, "link_bytes": 0,
+                                        "est_us": 0.0, "count": 0})
+        agg["bytes"] += rec["bytes"]
+        agg["link_bytes"] += rec["link_bytes"]
+        agg["est_us"] += rec["est_us"]
+        agg["count"] += 1
+        total_link += rec["link_bytes"]
+    comm = {
+        "collectives": records,
+        "per_axis": per_axis,
+        "total_link_bytes": int(total_link),
+        "est_total_us": round(total_link / bw * 1e6, 3),
+        "link_gbps": bw / 1e9,
+    }
+    report.extras["comm"] = comm
+    report.add(
+        "comm-model", Severity.INFO,
+        "%d collective(s), %.3g MB on the busiest links (~%.3g us at "
+        "%.0f GB/s): %s"
+        % (len(records), total_link / 1e6, comm["est_total_us"], bw / 1e9,
+           "; ".join("%s: %d op(s) %.3g MB" % (ax, agg["count"],
+                                               agg["bytes"] / 1e6)
+                     for ax, agg in sorted(per_axis.items())) or "none"),
+        detail={k: v for k, v in comm.items() if k != "collectives"})
+    return report
+
+
+# ------------------------------------------------------------ module audit
+
+
+def analyze_module_sharding(mod, collectives: bool = True,
+                            context: str = "module-sharding") -> Report:
+    """The full sharding audit of a mesh-bound ``Module``: specs are
+    resolved exactly as the bind path resolves them (regex and all), the
+    program is the bound executor's forward. Returns an empty report for
+    mesh-less modules (nothing to audit)."""
+    import jax
+
+    report = Report(context=context)
+    mesh = getattr(mod, "_mesh", None)
+    if mesh is None:
+        return report
+    ex = mod._exec
+    shapes = {n: tuple(a.shape) for n, a in ex.arg_dict.items()}
+    shapes.update({n: tuple(a.shape) for n, a in ex.aux_dict.items()})
+    dtypes = {n: a.dtype for n, a in ex.arg_dict.items()}
+    dtypes.update({n: a.dtype for n, a in ex.aux_dict.items()})
+    specs = {}
+    for name in list(ex.arg_dict) + list(ex.aux_dict):
+        sharding = mod._sharding_for(name)
+        specs[name] = sharding.spec
+    # the FSDP audit is about PARAMETERS (and aux state) the module
+    # holds resident — data/label inputs are batch-sharded per step by
+    # the placer, not replicated residents, and must not be flagged
+    resident = list(getattr(mod, "_param_names", shapes)) \
+        + list(getattr(mod, "_aux_names", ()))
+    param_specs = {n: specs[n] for n in resident if n in specs}
+    param_shapes = {n: shapes[n] for n in resident if n in shapes}
+    check_specs(mesh, specs, shapes, report=report)
+    check_replicated(mesh, param_specs, param_shapes, dtypes,
+                     report=report)
+    # ambiguous regex layering: two patterns matching one param with
+    # different specs is a latent reshard (first-match wins today; a
+    # reorder silently changes the layout)
+    if getattr(mod, "_param_shardings", None):
+        pats = list(mod._param_shardings.items())
+        for name in sorted(param_specs):
+            # mirror _sharding_for's resolution exactly: an exact key
+            # wins unconditionally (deterministic — NOT a conflict, no
+            # matter what regexes also match); ambiguity exists only
+            # among >1 regex matches with no exact key
+            if name in mod._param_shardings:
+                continue
+            matches = [(p, s) for p, s in pats if re.fullmatch(p, name)]
+            if len({str(s) for _, s in matches}) > 1:
+                report.add(
+                    "spec-conflict", Severity.WARNING,
+                    "%r matches %d sharding patterns with different specs "
+                    "(%s) — first match wins; make one pattern "
+                    "authoritative"
+                    % (name, len(matches),
+                       "; ".join("%r -> %s" % m for m in matches)),
+                    node=name)
+    if collectives:
+        fn = ex._fn
+        key = jax.random.PRNGKey(0)
+        args = {n: a.data for n, a in ex.arg_dict.items()}
+        aux = {n: a.data for n, a in ex.aux_dict.items()}
+        try:
+            analyze_collectives(
+                lambda a, x: fn(a, x, key, False)[0], args, aux,
+                mesh=mesh, report=report, context=context)
+        except Exception as exc:                            # noqa: BLE001
+            report.add(
+                "comm-model", Severity.INFO,
+                "collective walk unavailable for this program (%s: %s)"
+                % (type(exc).__name__,
+                   (str(exc).splitlines() or [""])[0][:120]))
+    return report

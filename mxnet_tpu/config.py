@@ -128,6 +128,21 @@ register("MXNET_TPU_ANALYZE", _parse_analyze_mode, "off",
          "off = analyzer never imported (zero cost), warn = log "
          "WARNING+ findings, strict = raise MXNetError on ERROR "
          "findings before any compile")
+register("MXNET_TPU_ANALYZE_HBM_BUDGET", str, "",
+         "per-device memory budget for the analysis hbm-budget pass "
+         "(bytes, K/M/G/T suffixes: '16G'); when the static peak "
+         "estimate (bound buffers + activation high-water) exceeds it "
+         "the bind gets an ERROR finding naming the offending arrays — "
+         "rejected before any compile under MXNET_TPU_ANALYZE=strict. "
+         "Empty = no budget")
+register("MXNET_TPU_ANALYZE_HBM_GBPS", float, 0.0,
+         "HBM bandwidth (GB/s) for the analysis roofline balance point; "
+         "0 = auto-detect from the TPU device_kind table (v2-v6); set "
+         "explicitly on unknown devices and in CPU tests")
+register("MXNET_TPU_ANALYZE_ICI_GBPS", float, 0.0,
+         "per-link ICI bandwidth (GB/s) for the analysis comm cost "
+         "model's time estimates; 0 = device_kind table, 50 GB/s for "
+         "unknown devices")
 register("MXNET_TPU_ASYNC_WINDOW", int, 2,
          "fit(): max train steps dispatched ahead of device completion "
          "(sliding-window sync caps in-flight work); 0 = fully "

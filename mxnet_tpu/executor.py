@@ -185,6 +185,9 @@ class Executor:
             shapes.update(
                 {n: tuple(a.shape) for n, a in self.aux_dict.items()})
             dtypes = {n: a.dtype for n, a in self.arg_dict.items()}
+            # aux dtypes too: the memory passes price BatchNorm running
+            # stats against the HBM budget at their real width
+            dtypes.update({n: a.dtype for n, a in self.aux_dict.items()})
             _check_bind(symbol, input_shapes=shapes,
                         input_dtypes=dtypes, mode=_analyze_mode,
                         context="bind")

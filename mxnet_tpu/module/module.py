@@ -341,11 +341,22 @@ class Module(BaseModule):
                              allow_missing=False, force_init=True)
 
     # -------------------------------------------------------------- analysis
-    def analyze(self, input_shapes=None, input_dtypes=None):
-        """Run the static graph analyzer (``mxnet_tpu.analysis``) over this
-        module's symbol. Bound modules analyze with their actual bound
-        shapes; unbound ones need ``input_shapes``. Returns an
-        ``analysis.Report`` (lazy import — never loaded unless called)."""
+    def analyze(self, input_shapes=None, input_dtypes=None,
+                sharding=False, collectives=False):
+        """Run the static analyzer (``mxnet_tpu.analysis``) over this
+        module's symbol: graph passes plus the memory passes (remat
+        opportunities, HBM budget). Bound modules analyze with their
+        actual bound shapes; unbound ones need ``input_shapes``.
+
+        ``sharding=True`` additionally runs the sharding/communication
+        audit on a mesh-bound module (spec validity, FSDP opportunities,
+        ambiguous regex layering) — with ``collectives=True`` it also
+        compiles the bound forward against its shardings and walks the
+        partitioned HLO for collectives (``Report.extras["comm"]``;
+        compiles one executable, so it is opt-in).
+
+        Returns an ``analysis.Report`` (lazy import — never loaded
+        unless called)."""
         from ..analysis import analyze_symbol
         shapes = {k: tuple(v) for k, v in (input_shapes or {}).items()}
         if not shapes and self.binded:
@@ -353,8 +364,14 @@ class Module(BaseModule):
                       for n, a in self._exec.arg_dict.items()}
             shapes.update({n: tuple(a.shape)
                            for n, a in self._exec.aux_dict.items()})
-        return analyze_symbol(self._symbol, input_shapes=shapes or None,
-                              input_dtypes=input_dtypes, context="module")
+        report = analyze_symbol(self._symbol, input_shapes=shapes or None,
+                                input_dtypes=input_dtypes,
+                                context="module")
+        if sharding and self.binded and self._mesh is not None:
+            from ..analysis import analyze_module_sharding
+            report.extend(analyze_module_sharding(
+                self, collectives=collectives))
+        return report
 
     # ------------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",

@@ -62,9 +62,22 @@ compiles.install()
 
 def report() -> Dict[str, Any]:
     """One observability snapshot: per-executor utilization (this call
-    is the rate boundary — see :mod:`.mfu`), the compile ring, and the
-    ``obs_*`` counters/gauges/histogram summaries."""
+    is the rate boundary — see :mod:`.mfu`), roofline reconciliation
+    (*why* is MFU what it is — compute- vs memory-bound, attainable vs
+    measured; attached when the analysis package is already loaded,
+    which the MFU collector's lazy import guarantees whenever there is
+    a FLOP count to explain), the compile ring, and the ``obs_*``
+    counters/gauges/histogram summaries."""
     executors = mfu.collect()
+    import sys
+    if "mxnet_tpu.analysis" in sys.modules:
+        from ..analysis import roofline as _roofline
+        for rec in executors:
+            cost = rec.get("cost") or {}
+            if cost.get("flops") and cost.get("bytes_moved"):
+                rec["roofline"] = _roofline.explain(
+                    cost["flops"], cost["bytes_moved"],
+                    measured_mfu=rec.get("mfu"))
     hist = {}
     for name, h in _profiler.histograms().items():
         if not name.startswith("obs_"):

@@ -109,10 +109,18 @@ def _flops_per_step(mod) -> Optional[float]:
     val = 0.0
     try:
         report = mod.analyze()
-        fwd = float(report.extras.get("cost", {}).get("flops") or 0)
+        cost = report.extras.get("cost", {})
+        fwd = float(cost.get("flops") or 0)
         mult = TRAIN_FLOP_MULTIPLIER \
             if getattr(mod, "optimizer_initialized", False) else 1.0
         val = fwd * mult
+        # cost-model bytes ride along for the roofline reconciliation in
+        # mx.obs.report() — the "why" next to the MFU number (the train
+        # step touches roughly the same tensors ~3x, so the forward
+        # intensity is the step intensity to first order)
+        mod._obs_cost = {"flops": val,
+                         "bytes_moved": float(cost.get("bytes_moved")
+                                              or 0) * mult}
     except Exception:                                      # noqa: BLE001
         pass       # partial graphs / custom ops: report without MFU
     mod._obs_flops_per_step = val
@@ -146,6 +154,7 @@ def _collect_locked() -> List[Dict[str, Any]]:
             "flops_per_sec": None,
             "mfu": None,
             "peak_flops": peak,
+            "cost": getattr(mod, "_obs_cost", None),
         }
         t0 = getattr(mod, "_obs_t0", None)
         # >= so a collect at EXACTLY warmup steps (bench.py's

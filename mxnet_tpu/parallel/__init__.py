@@ -23,4 +23,26 @@ __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "replicated_sharding", "shard_batch", "replicate", "P", "Mesh",
            "NamedSharding", "mesh_devices", "ring_attention",
            "ring_self_attention", "local_attention_block",
-           "pipeline_apply", "pipeline_1f1b", "stack_stage_params", "moe_init", "moe_apply"]
+           "pipeline_apply", "pipeline_1f1b", "stack_stage_params",
+           "moe_init", "moe_apply", "sharding_islands"]
+
+
+def sharding_islands():
+    """Every parallel mode's canonical layout claims, keyed by island
+    name — the input of ``analysis.sharding_passes.check_islands``.
+    Until ROADMAP item 1 unifies these behind one SpecLayout, the
+    islands legitimately disagree (each assumes its own mesh axis and
+    its own batch layout); the audit keeps those disagreements *visible*
+    instead of discovered on a multi-chip bill."""
+    # NOTE: `from . import ring_attention` would return the FUNCTION of
+    # the same name re-exported above, not the submodule — import the
+    # island declarations directly
+    from .mesh import sharding_island as _mesh_island
+    from .moe import sharding_island as _moe_island
+    from .pipeline import sharding_island as _pipe_island
+    from .ring_attention import sharding_island as _ring_island
+    islands = {}
+    for fn in (_mesh_island, _moe_island, _pipe_island, _ring_island):
+        name, specs = fn()
+        islands[name] = specs
+    return islands

@@ -21,7 +21,15 @@ from ..context import Context
 
 __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "replicated_sharding", "shard_batch", "replicate", "P", "Mesh",
-           "NamedSharding", "mesh_devices"]
+           "NamedSharding", "mesh_devices", "sharding_island"]
+
+
+def sharding_island():
+    """This module's canonical layout claims, auditable by
+    ``analysis.sharding_passes.check_islands`` (ROADMAP item 1: today
+    each parallel mode is its own island; the audit makes the
+    disagreements visible until one SpecLayout unifies them)."""
+    return "mesh", {"batch": P("data"), "param": P()}
 
 
 def mesh_devices(contexts: Optional[Sequence[Context]] = None) -> List[jax.Device]:

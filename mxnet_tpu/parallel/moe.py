@@ -11,7 +11,22 @@ comms; everything stays differentiable and jit-compatible.
 """
 from __future__ import annotations
 
-__all__ = ["moe_init", "moe_apply"]
+__all__ = ["moe_init", "moe_apply", "sharding_island"]
+
+
+def sharding_island():
+    """Canonical layout claims of the expert-parallel island (audited by
+    ``analysis.sharding_passes.check_islands``): dispatched activations
+    and expert FFN weights are sharded over the ``expert`` axis — an
+    axis the default ``data x model`` mesh does not carry, which is
+    exactly the cross-island gap the audit surfaces."""
+    from jax.sharding import PartitionSpec as P
+    return "moe", {
+        "expert_in": P("expert", None, None),
+        "expert_out": P("expert", None, None),
+        "expert_param": P("expert", None, None),
+        "batch": P(None),          # tokens arrive unsharded, all_to_all'd
+    }
 
 
 def moe_init(rng, d_model: int, d_hidden: int, n_experts: int, dtype=None):

@@ -25,7 +25,20 @@ from __future__ import annotations
 
 import functools
 
-__all__ = ["pipeline_apply", "pipeline_1f1b", "stack_stage_params"]
+__all__ = ["pipeline_apply", "pipeline_1f1b", "stack_stage_params",
+           "sharding_island"]
+
+
+def sharding_island():
+    """Canonical layout claims of the pipeline island (audited by
+    ``analysis.sharding_passes.check_islands``): stacked stage
+    parameters are sharded over the ``pipe`` axis, microbatch
+    activations ride replicated and hop stages via ``ppermute``."""
+    from jax.sharding import PartitionSpec as P
+    return "pipeline", {
+        "stage_params": P("pipe"),
+        "batch": P(None),
+    }
 
 
 def stack_stage_params(per_stage_params):

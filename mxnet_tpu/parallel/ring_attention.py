@@ -24,7 +24,19 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["ring_attention", "ring_self_attention", "local_attention_block"]
+__all__ = ["ring_attention", "ring_self_attention",
+           "local_attention_block", "sharding_island"]
+
+
+def sharding_island():
+    """Canonical layout claims of the sequence-parallel island (audited
+    by ``analysis.sharding_passes.check_islands``): q/k/v carry the
+    sequence dim sharded over ``sp`` — another axis the default mesh
+    does not yet carry (ROADMAP item 1)."""
+    return "ring_attention", {
+        "qkv_seq": P(None, None, "sp", None),
+        "batch": P(None),
+    }
 
 
 def local_attention_block(q, k, v, mask=None, scale=None):

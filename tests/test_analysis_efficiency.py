@@ -1,0 +1,802 @@
+"""Efficiency auditor (ISSUE 8): memory/remat, sharding/comm, roofline.
+
+Coverage contract (acceptance criteria):
+
+* every new pass has a fires/stays-silent pair (over-budget vs fits,
+  resharding thrash vs clean TP layout, replicated-param vs
+  FSDP-sharded, signal-unsafe handler vs flag-only handler);
+* the zoo transformer's remat report's top suggestion, applied as a
+  ``jax.checkpoint`` policy, measurably reduces the program's analyzed
+  peak activation memory (``analyze_program_memory``);
+* the TP mesh module's audit reports per-axis comm bytes matching a
+  hand-computed value for a known collective (the Megatron fc2
+  all-reduce);
+* strict mode rejects an over-HBM-budget bind with a finding naming the
+  offending arrays;
+* the grouped/depthwise-conv and pooling FLOP rules parity-test against
+  closed forms;
+* a model-zoo audit run (MLP, resnet8, transformer, TP mesh module)
+  produces zero ERROR findings and non-empty remat/comm reports.
+
+The ``MXNET_TPU_ANALYZE=off`` zero-import gate lives in
+``tests/test_analysis.py::test_analyze_off_is_zero_cost`` and now covers
+the new pass families for free (they are part of the same package).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.analysis import (Severity, analyze_collectives,
+                                analyze_module_sharding,
+                                analyze_program_memory, analyze_symbol,
+                                check_islands, check_replicated,
+                                check_specs, lint_source, parse_bytes,
+                                roofline, stale_baseline, write_baseline,
+                                load_baseline)
+from mxnet_tpu.parallel import P, make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+def codes(report, code=None):
+    if code is None:
+        return [f.code for f in report]
+    return [f for f in report if f.code == code]
+
+
+def _transformer():
+    from mxnet_tpu.models import transformer
+    net = transformer.get_symbol(vocab_size=128, num_layers=2,
+                                 d_model=32, n_heads=2, seq_len=16)
+    return net, {"data": (2, 16), "softmax_label": (2, 16)}
+
+
+def _tp_module():
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = sym.Activation(h, act_type="tanh")
+    h = sym.FullyConnected(h, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(h, name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                        mesh_shape={"data": 2, "model": 4},
+                        param_shardings={"fc1_weight": P("model", None),
+                                         "fc1_bias": P("model"),
+                                         "fc2_weight": P(None, "model")})
+    mod.bind(data_shapes=[("data", (64, 6))],
+             label_shapes=[("softmax_label", (64,))])
+    mod.init_params(mx.init.Uniform(0.01))
+    return mod
+
+
+# ================================================== cost-model satellites
+
+
+def test_pooling_flops_closed_form():
+    """max pool: one compare per window element per output element; the
+    old per-element fallback undercounted by prod(kernel)."""
+    d = sym.Variable("data")
+    net = sym.Pooling(d, kernel=(3, 3), pool_type="max", name="pool")
+    report = analyze_symbol(net, input_shapes={"data": (2, 4, 16, 16)})
+    assert not report.errors
+    out_elems = 2 * 4 * 14 * 14
+    assert report.extras["cost"]["flops"] == out_elems * 9
+
+
+def test_avg_pooling_adds_divide():
+    d = sym.Variable("data")
+    net = sym.Pooling(d, kernel=(2, 2), pool_type="avg", name="pool")
+    report = analyze_symbol(net, input_shapes={"data": (2, 4, 8, 8)})
+    out_elems = 2 * 4 * 7 * 7
+    assert report.extras["cost"]["flops"] == out_elems * 4 + out_elems
+
+
+def test_global_pooling_uses_input_window():
+    d = sym.Variable("data")
+    net = sym.Pooling(d, global_pool=True, kernel=(1, 1),
+                      pool_type="max", name="pool")
+    report = analyze_symbol(net, input_shapes={"data": (2, 4, 8, 8)})
+    assert report.extras["cost"]["flops"] == 2 * 4 * (8 * 8)
+
+
+def test_grouped_conv_flops_closed_form():
+    """grouped conv weight is (nf, cin/g, *k): 2 * out * cin/g * k*k."""
+    d = sym.Variable("data")
+    net = sym.Convolution(d, num_filter=8, kernel=(3, 3), num_group=4,
+                          no_bias=True, name="conv")
+    report = analyze_symbol(net, input_shapes={"data": (2, 8, 8, 8)})
+    assert not report.errors
+    out_elems = 2 * 8 * 6 * 6
+    assert report.extras["cost"]["flops"] == 2 * out_elems * (8 // 4) * 9
+
+
+def test_depthwise_conv_flops_closed_form():
+    d = sym.Variable("data")
+    net = sym.Convolution(d, num_filter=8, kernel=(3, 3), num_group=8,
+                          no_bias=True, name="conv")
+    report = analyze_symbol(net, input_shapes={"data": (2, 8, 8, 8)})
+    out_elems = 2 * 8 * 6 * 6
+    assert report.extras["cost"]["flops"] == 2 * out_elems * 1 * 9
+
+
+def test_deconv_flops_use_cin_not_nf():
+    """Deconvolution weight is (cin, nf/g, *k): the contraction depth is
+    cin/g — pricing through w[1:] would charge nf/g instead."""
+    d = sym.Variable("data")
+    net = sym.Deconvolution(d, num_filter=6, kernel=(3, 3), no_bias=True,
+                            name="deconv")
+    report = analyze_symbol(net, input_shapes={"data": (2, 4, 8, 8)})
+    assert not report.errors
+    out_elems = 2 * 6 * 10 * 10
+    assert report.extras["cost"]["flops"] == 2 * out_elems * 4 * 9
+
+
+# ========================================================= memory passes
+
+
+def test_parse_bytes_suffixes():
+    assert parse_bytes("") == 0 and parse_bytes(None) == 0
+    assert parse_bytes("1024") == 1024
+    assert parse_bytes("4K") == 4096
+    assert parse_bytes("1.5M") == int(1.5 * (1 << 20))
+    assert parse_bytes("16G") == 16 << 30
+    # natural spellings parse too
+    assert parse_bytes("16GB") == 16 << 30
+    assert parse_bytes("512 MiB") == 512 << 20
+    with pytest.raises(ValueError, match="16Q"):
+        parse_bytes("16Q")
+
+
+def test_hbm_budget_typo_degrades_not_crashes():
+    """A config typo must not brick binds: warn-mode contract is 'log
+    and proceed', so garbage degrades to a WARNING naming the knob."""
+    from mxnet_tpu.models import mlp
+    net = mlp.get_symbol(num_classes=10)
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_BUDGET", "lots")
+    try:
+        report = analyze_symbol(net, input_shapes={"data": (32, 784),
+                                                   "softmax_label": (32,)})
+    finally:
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_BUDGET")
+    hits = codes(report, "hbm-budget")
+    assert hits and hits[0].severity == Severity.WARNING
+    assert "NOT being enforced" in hits[0].message
+
+
+def test_cli_lint_no_paths_is_usage_error():
+    from mxnet_tpu.analysis.__main__ import main
+    assert main(["lint"]) == 2
+
+
+def test_cli_audit_typo_target_is_usage_error(capsys):
+    from mxnet_tpu.analysis.__main__ import main
+    assert main(["audit", "transfromer"]) == 2
+    assert "unknown zoo model" in capsys.readouterr().err
+
+
+def test_negative_budget_rejected_not_silent():
+    with pytest.raises(ValueError, match="negative"):
+        parse_bytes("-16G")
+    from mxnet_tpu.models import mlp
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_BUDGET", "-16G")
+    try:
+        report = analyze_symbol(mlp.get_symbol(num_classes=10),
+                                input_shapes={"data": (32, 784),
+                                              "softmax_label": (32,)})
+    finally:
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_BUDGET")
+    hits = codes(report, "hbm-budget")
+    assert hits and "NOT being enforced" in hits[0].message
+
+
+def test_program_memory_unused_output_dies_immediately():
+    """An eqn output nothing consumes (dropped tuple element) must not
+    stay 'live' to the end of the program — it would inflate every
+    later point of the high-water walk."""
+    def f(x):
+        a, v = jax.lax.sort_key_val(x, x * 2.0)   # v is never used
+        big = jnp.concatenate([a, a, a, a], axis=0)
+        return jnp.sum(big)
+
+    x = jnp.ones((256, 256), jnp.float32)
+    mem = analyze_program_memory(f, x).extras["program_memory"]
+    buf = 256 * 256 * 4
+    # peak is at the concat output (a + 4a); the sort moment holds
+    # m + a + v = 3 bufs. If the unused v leaked, the concat point
+    # would count a + 4a + v = 6 bufs.
+    assert mem["activation_peak_bytes"] == 5 * buf
+
+
+def test_hbm_budget_fires_and_names_offenders():
+    from mxnet_tpu.models import mlp
+    net = mlp.get_symbol(num_classes=10)
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_BUDGET", "100K")
+    try:
+        report = analyze_symbol(net, input_shapes={"data": (32, 784),
+                                                   "softmax_label": (32,)})
+    finally:
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_BUDGET")
+    hits = codes(report, "hbm-budget")
+    assert hits and hits[0].severity == Severity.ERROR
+    # the finding names the offending arrays — the fc1 weight dominates
+    assert "fc1_weight" in hits[0].message
+    assert not report.extras["hbm_budget"]["fits"]
+
+
+def test_hbm_budget_fits_stays_silent():
+    from mxnet_tpu.models import mlp
+    net = mlp.get_symbol(num_classes=10)
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_BUDGET", "1G")
+    try:
+        report = analyze_symbol(net, input_shapes={"data": (32, 784),
+                                                   "softmax_label": (32,)})
+    finally:
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_BUDGET")
+    assert not codes(report, "hbm-budget")
+    assert report.extras["hbm_budget"]["fits"]
+
+
+def test_hbm_budget_unset_no_extras():
+    from mxnet_tpu.models import mlp
+    report = analyze_symbol(mlp.get_symbol(num_classes=10),
+                            input_shapes={"data": (32, 784),
+                                          "softmax_label": (32,)})
+    assert "hbm_budget" not in report.extras
+
+
+def test_strict_mode_rejects_over_budget_bind():
+    """The acceptance drill: strict mode rejects an over-HBM-budget bind
+    before any compile, naming the offending arrays."""
+    d = sym.Variable("data")
+    net = sym.FullyConnected(d, num_hidden=256, name="fc_big")
+    mx.config.set("MXNET_TPU_ANALYZE", "strict")
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_BUDGET", "64K")
+    try:
+        with pytest.raises(mx.MXNetError, match="hbm-budget") as exc_info:
+            net.simple_bind(mx.cpu(), data=(16, 512))
+        assert "fc_big_weight" in str(exc_info.value)
+        # and the same bind FITS a real budget
+        mx.config.set("MXNET_TPU_ANALYZE_HBM_BUDGET", "16G")
+        ex = net.simple_bind(mx.cpu(), data=(16, 512))
+        assert ex.forward()[0].shape == (16, 256)
+    finally:
+        mx.config.reset("MXNET_TPU_ANALYZE")
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_BUDGET")
+
+
+def test_remat_report_transformer_nonempty():
+    net, shapes = _transformer()
+    report = analyze_symbol(net, input_shapes=shapes)
+    assert codes(report, "remat-opportunity")
+    remat = report.extras["remat"]
+    assert remat["candidates"]
+    sug = remat["suggestion"]
+    assert hasattr(jax.checkpoint_policies, sug["policy"])
+    assert "jax.checkpoint" in sug["hint"]
+
+
+def test_remat_silent_on_tiny_graph():
+    d = sym.Variable("data")
+    net = sym.FullyConnected(d, num_hidden=4, name="fc")
+    report = analyze_symbol(net, input_shapes={"data": (2, 8)})
+    assert not codes(report, "remat-opportunity")
+    assert "remat" not in report.extras
+
+
+def test_program_memory_hand_computed_chain():
+    def f(a):
+        b = a + 1.0
+        return b * 2.0
+
+    x = jnp.ones((256, 256), jnp.float32)
+    report = analyze_program_memory(f, x)
+    mem = report.extras["program_memory"]
+    buf = 256 * 256 * 4
+    # b and the output coexist for one step: the 2-buffer peak
+    assert mem["activation_peak_bytes"] == 2 * buf
+    assert mem["arg_bytes"] == buf
+    assert mem["top_live"]
+
+
+def test_remat_top_suggestion_reduces_analyzed_peak():
+    """THE acceptance criterion: the zoo transformer's top remat
+    suggestion, applied as a jax.checkpoint policy (per repeated block,
+    as the hint instructs), measurably reduces the grad program's
+    analyzed peak activation memory."""
+    net, shapes = _transformer()
+    sug = analyze_symbol(net, input_shapes=shapes) \
+        .extras["remat"]["suggestion"]
+    policy = getattr(jax.checkpoint_policies, sug["policy"])
+
+    # a transformer-block-shaped program (attention internals T x T >>
+    # the T x d block boundary — the regime the suggestion targets)
+    T, D, L = 128, 16, 4
+
+    def block(x, w):
+        s = jax.nn.softmax((x @ x.T) / np.sqrt(D))
+        return jnp.tanh(s @ x @ w)
+
+    def plain(params, x):
+        for w in params:
+            x = block(x, w)
+        return jnp.sum(x)
+
+    def rematted(params, x):
+        ck = jax.checkpoint(block, policy=policy)
+        for w in params:
+            x = ck(x, w)
+        return jnp.sum(x)
+
+    params = [jnp.ones((D, D), jnp.float32) for _ in range(L)]
+    x = jnp.ones((T, D), jnp.float32)
+    peak_plain = analyze_program_memory(
+        jax.grad(plain), params, x).extras["program_memory"][
+        "activation_peak_bytes"]
+    peak_remat = analyze_program_memory(
+        jax.grad(rematted), params, x).extras["program_memory"][
+        "activation_peak_bytes"]
+    assert peak_remat < 0.95 * peak_plain, \
+        "suggested policy %s did not reduce analyzed peak (%d -> %d)" \
+        % (sug["policy"], peak_plain, peak_remat)
+
+
+# ======================================================= sharding passes
+
+
+@needs_8_devices
+def test_spec_audit_fires_and_stays_silent():
+    mesh = make_mesh({"data": 2, "model": 4})
+    shapes = {"w": (32, 6), "b": (6, 32)}
+    # unknown axis fires
+    r = check_specs(mesh, {"w": P("expert", None)}, shapes)
+    assert codes(r, "spec-axis") and r.errors
+    # over-ranked spec fires
+    r = check_specs(mesh, {"w": P("model", None, None)}, shapes)
+    assert codes(r, "spec-rank")
+    # non-dividing dim fires (6 rows over 4 shards)
+    r = check_specs(mesh, {"b": P("model", None)}, shapes)
+    assert codes(r, "spec-divisibility")
+    # one axis on two dims fires
+    r = check_specs(mesh, {"w": P("model", "model")}, shapes)
+    assert codes(r, "spec-duplicate-axis")
+    # a clean TP layout is silent
+    r = check_specs(mesh, {"w": P("model", None), "b": P(None, "model")},
+                    {"w": (32, 6), "b": (6, 32)})
+    assert not r.findings
+
+
+@needs_8_devices
+def test_reshard_thrash_fires_vs_clean_layout():
+    mesh = make_mesh({"data": 2, "model": 4})
+    # the same activation declared with different layouts in two stages:
+    # every boundary crossing reshards it
+    islands = {"stage0": {"x": P("data", None)},
+               "stage1": {"x": P(None, "model")}}
+    r = check_islands(islands, mesh=mesh, shapes={"x": (64, 32)})
+    hits = codes(r, "reshard-thrash")
+    assert hits and hits[0].severity == Severity.WARNING
+    assert "stage0" in hits[0].message and "stage1" in hits[0].message
+    # a clean TP layout (same spec everywhere) is silent
+    r = check_islands({"stage0": {"x": P("data", None)},
+                       "stage1": {"x": P("data", None)}}, mesh=mesh)
+    assert not codes(r, "reshard-thrash")
+
+
+@needs_8_devices
+def test_fsdp_opportunity_fires_vs_sharded():
+    mesh = make_mesh({"data": 2, "model": 4})
+    shapes = {"big_weight": (1024, 1024), "small_bias": (32,)}
+    r = check_replicated(mesh, {}, shapes)
+    hits = codes(r, "fsdp-opportunity")
+    assert len(hits) == 1 and hits[0].node == "big_weight"
+    # 4 MiB replicated over 8 devices: 7/8 recoverable
+    assert hits[0].detail["recovered_bytes_per_device"] == \
+        1024 * 1024 * 4 * 7 // 8
+    # the sharded version of the same param is silent
+    r = check_replicated(mesh, {"big_weight": P("model", None)}, shapes)
+    assert not codes(r, "fsdp-opportunity")
+
+
+def test_islands_cross_check_runs():
+    from mxnet_tpu.parallel import sharding_islands
+    islands = sharding_islands()
+    assert {"mesh", "moe", "pipeline", "ring_attention"} <= set(islands)
+    # without a mesh, only cross-island disagreements are reported —
+    # today's islands disagree on the batch layout (ROADMAP item 1)
+    r = check_islands(islands)
+    assert codes(r, "reshard-thrash")
+    assert not r.errors
+
+
+@needs_8_devices
+def test_collective_walk_hand_computed_all_reduce():
+    """Row-parallel matmul: contraction over the model-sharded K dim
+    with a replicated output forces exactly one all-reduce of the
+    output buffer — bytes and ring link traffic are hand-computable."""
+    from jax.sharding import NamedSharding
+    mesh = make_mesh({"data": 2, "model": 4})
+    B, K, N = 16, 64, 32
+    xs = NamedSharding(mesh, P(None, "model"))
+    ws = NamedSharding(mesh, P("model", None))
+    x = jax.device_put(jnp.ones((B, K)), xs)
+    w = jax.device_put(jnp.ones((K, N)), ws)
+    r = analyze_collectives(lambda a, b: a @ b, x, w, mesh=mesh,
+                            out_shardings=NamedSharding(mesh, P()))
+    comm = r.extras["comm"]
+    model = comm["per_axis"]["model"]
+    assert model["count"] == 1
+    assert model["bytes"] == B * N * 4                    # 2048
+    assert model["link_bytes"] == 2 * (4 - 1) * B * N * 4 // 4   # ring
+    assert comm["est_total_us"] > 0
+    ar = [c for c in comm["collectives"] if c["kind"] == "all-reduce"]
+    assert ar and ar[0]["axes"] == ["model"]
+
+
+@needs_8_devices
+def test_tp_module_audit_comm_matches_hand_value():
+    """The Megatron MLP forward has ONE all-reduce over `model` (fc2's
+    row-parallel contraction) of the (64, 2) f32 logits = 512 bytes."""
+    mod = _tp_module()
+    report = analyze_module_sharding(mod)
+    assert not report.errors, report.format(Severity.ERROR)
+    comm = report.extras["comm"]
+    assert comm["collectives"], "comm report must be non-empty"
+    model = comm["per_axis"]["model"]
+    assert model["bytes"] == 64 * 2 * 4
+    assert model["link_bytes"] == 2 * (4 - 1) * 64 * 2 * 4 // 4
+
+
+@needs_8_devices
+def test_module_analyze_sharding_surface():
+    mod = _tp_module()
+    report = mod.analyze(sharding=True)
+    # graph passes AND spec audit ride one report; zero errors on the
+    # healthy TP layout
+    assert not report.errors, report.format(Severity.ERROR)
+    assert "cost" in report.extras
+    # fc1_bias (8,) over model=4: divisible; nothing to flag
+    assert not codes(report, "spec-axis")
+
+
+def _conflict_module(param_shardings):
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=32, name="fc1"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                        mesh_shape={"data": 2, "model": 4},
+                        param_shardings=param_shardings)
+    mod.bind(data_shapes=[("data", (64, 8))],
+             label_shapes=[("softmax_label", (64,))])
+    mod.init_params(mx.init.Uniform(0.01))
+    return mod
+
+
+@needs_8_devices
+def test_module_spec_conflict_regex_layering():
+    """Two overlapping regexes with different specs are ambiguous (dict
+    order decides the layout) — flagged."""
+    mod = _conflict_module({r"fc1_w.*": P("model", None),
+                            r"fc1_.*ght": P(None, "model")})
+    report = analyze_module_sharding(mod, collectives=False)
+    hits = codes(report, "spec-conflict")
+    assert hits and "fc1_weight" in hits[0].message
+
+
+@needs_8_devices
+def test_module_audit_does_not_flag_batch_inputs_as_fsdp():
+    """data/label are batch-sharded per step by the placer — a big
+    batch input must not show up as a 'replicated parameter' FSDP
+    opportunity."""
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=32, name="fc1"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                        mesh_shape={"data": 2, "model": 4})
+    mod.bind(data_shapes=[("data", (4096, 784))],
+             label_shapes=[("softmax_label", (4096,))])
+    mod.init_params(mx.init.Uniform(0.01))
+    report = analyze_module_sharding(mod, collectives=False)
+    assert not codes(report, "fsdp-opportunity"), \
+        report.format(Severity.WARNING)
+
+
+@needs_8_devices
+def test_module_spec_exact_key_beats_regex_silently():
+    """An exact key wins unconditionally in _sharding_for — an
+    overlapping regex is NOT a conflict (mirrors bind resolution)."""
+    mod = _conflict_module({"fc1_weight": P("model", None),
+                            r"fc1_w.*": P(None, "model")})
+    report = analyze_module_sharding(mod, collectives=False)
+    assert not codes(report, "spec-conflict")
+
+
+# ============================================================== roofline
+
+
+def test_roofline_classification_pair():
+    """A fat matmul classifies compute-bound, an elementwise add
+    memory-bound, against a knob-pinned device roofline."""
+    mx.config.set("MXNET_TPU_OBS_PEAK_FLOPS", 1e12)     # 1 TFLOP/s
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_GBPS", 100.0)  # balance = 10
+    try:
+        r = roofline.analyze_executable(
+            lambda a, b: a @ b, jnp.ones((256, 256)), jnp.ones((256, 256)))
+        roof = r.extras["roofline"]
+        assert roof["bound"] == "compute"
+        assert roof["attainable_mfu"] == 1.0
+        r = roofline.analyze_executable(
+            lambda a, b: a + b, jnp.ones((256, 256)), jnp.ones((256, 256)))
+        roof = r.extras["roofline"]
+        assert roof["bound"] == "memory"
+        assert roof["attainable_mfu"] < 0.05
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_PEAK_FLOPS")
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_GBPS")
+
+
+def test_flop_model_drift_fires_and_stays_silent():
+    a, b = jnp.ones((128, 128)), jnp.ones((128, 128))
+    true_flops = 2 * 128 * 128 * 128
+    # an undercounting model (the per-element shape) fires
+    r = roofline.analyze_executable(lambda a, b: a @ b, a, b,
+                                    model_flops=128 * 128)
+    assert codes(r, "flop-model-drift")
+    # the correct closed form is silent
+    r = roofline.analyze_executable(lambda a, b: a @ b, a, b,
+                                    model_flops=true_flops)
+    assert not codes(r, "flop-model-drift")
+    assert abs(r.extras["roofline"]["model_ratio"] - 1.0) <= 0.25
+
+
+def test_roofline_explain_reconciles_measured_mfu():
+    mx.config.set("MXNET_TPU_OBS_PEAK_FLOPS", 1e12)
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_GBPS", 100.0)
+    try:
+        # memory-bound program already at its roofline: the why says
+        # raise intensity, not scheduling
+        out = roofline.explain(flops=1e9, bytes_moved=1e9,
+                               measured_mfu=0.1)
+        assert out["bound"] == "memory"
+        assert "intensity" in out["why"]
+        # far below an attainable roofline: the why blames scheduling
+        out = roofline.explain(flops=1e9, bytes_moved=1e7,
+                               measured_mfu=0.05)
+        assert out["bound"] == "compute"
+        assert "scheduling" in out["why"]
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_PEAK_FLOPS")
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_GBPS")
+
+
+def test_obs_report_carries_roofline_why():
+    """mx.obs.report() attaches the roofline reconciliation to each
+    executor record — the PR 6 MFU numbers come with a why attached."""
+    from mxnet_tpu.initializer import Uniform
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=8,
+                                               name="fc1"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(Uniform(0.01))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.uniform(-1, 1, (8, 16)))],
+        label=[mx.nd.array(rng.randint(0, 8, (8,)))])
+    mx.config.set("MXNET_TPU_OBS_PEAK_FLOPS", 1e12)
+    mx.config.set("MXNET_TPU_ANALYZE_HBM_GBPS", 100.0)
+    try:
+        for _ in range(4):
+            mod._fit_step(batch)
+        mx.obs.report()                      # opens the rate window
+        for _ in range(3):
+            mod._fit_step(batch)
+        rep = mx.obs.report()
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_PEAK_FLOPS")
+        mx.config.reset("MXNET_TPU_ANALYZE_HBM_GBPS")
+    recs = [r for r in rep["executors"]
+            if r["name"].startswith("fused_step") and r.get("roofline")]
+    assert recs, rep["executors"]
+    roof = recs[-1]["roofline"]
+    assert roof["bound"] in ("compute", "memory")
+    assert "why" in roof and roof["measured_mfu"] is not None
+
+
+# ===================================================== signal-unsafe lint
+
+
+SIG_BAD = """
+import signal, threading, logging
+lock = threading.Lock()
+
+def handler(signum, frame):
+    with lock:
+        logging.warning("dying")
+
+signal.signal(signal.SIGTERM, handler)
+"""
+
+SIG_OK = """
+import signal
+
+class Mgr:
+    def install(self):
+        def _handler(signum, frame):
+            self._preempt = True       # flag-only: the PR 5 discipline
+        signal.signal(signal.SIGTERM, _handler)
+"""
+
+
+def test_signal_unsafe_fires():
+    report = lint_source(SIG_BAD, path="s.py")
+    hits = codes(report, "signal-unsafe")
+    assert len(hits) == 2
+    sev = {f.severity for f in hits}
+    assert Severity.ERROR in sev         # the lock acquisition
+    assert Severity.WARNING in sev       # the logging call
+    assert all(f.func == "handler" for f in hits)
+
+
+def test_signal_unsafe_flag_only_stays_silent():
+    assert not codes(lint_source(SIG_OK, path="s.py"), "signal-unsafe")
+
+
+def test_signal_unsafe_method_handler_and_queue():
+    src = """
+import signal
+
+class Mgr:
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self._queue.put(1)             # blocks on the queue lock
+"""
+    hits = codes(lint_source(src, path="m.py"), "signal-unsafe")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "_queue.put" in hits[0].message
+
+
+def test_signal_unsafe_same_code_outside_handler_silent():
+    src = """
+import threading, logging
+lock = threading.Lock()
+
+def not_a_handler():
+    with lock:
+        logging.warning("fine: nobody registered this with signal")
+"""
+    assert not codes(lint_source(src, path="n.py"), "signal-unsafe")
+
+
+def test_signal_unsafe_inline_suppression():
+    src = SIG_BAD.replace(
+        "with lock:",
+        "with lock:  # mx-lint: allow(signal-unsafe)")
+    hits = codes(lint_source(src, path="s.py"), "signal-unsafe")
+    assert len(hits) == 1                # only the logging WARNING left
+
+
+def test_checkpoint_manager_handler_is_clean():
+    """The PR 5 SIGTERM handler dodges this hazard class by hand; the
+    rule must agree."""
+    from mxnet_tpu.analysis import lint_paths
+    path = os.path.join(REPO, "mxnet_tpu", "checkpoint", "manager.py")
+    assert not codes(lint_paths([path]), "signal-unsafe")
+
+
+# ======================================================= baseline drift
+
+
+def test_stale_baseline_detected(tmp_path):
+    locked = """
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fetch(self, arr):
+        with self._lock:
+            return arr.asnumpy()
+"""
+    src = tmp_path / "s.py"
+    src.write_text(locked)
+    report = lint_source(locked, path=str(src))
+    bl = str(tmp_path / "bl.json")
+    write_baseline(report, bl, str(tmp_path))
+    # the debt gets paid off: the baseline is now stale
+    fixed = locked.replace("with self._lock:\n            return",
+                           "if True:\n            return")
+    src.write_text(fixed)
+    clean = lint_source(fixed, path=str(src))
+    stale = stale_baseline(clean, load_baseline(bl), str(tmp_path))
+    assert stale and list(stale.values()) == [1]
+    # and the CLI gate fails on it (drift in the shrinking direction)
+    from mxnet_tpu.analysis.__main__ import main
+    assert main(["lint", str(src), "--root", str(tmp_path),
+                 "--baseline", bl]) == 1
+
+
+def test_baseline_gate_passes_when_in_sync(tmp_path):
+    src = tmp_path / "ok.py"
+    src.write_text("x = 1\n")
+    from mxnet_tpu.analysis.__main__ import main
+    bl = str(tmp_path / "bl.json")
+    assert main(["lint", str(src), "--root", str(tmp_path),
+                 "--write-baseline", bl]) == 0
+    assert main(["lint", str(src), "--root", str(tmp_path),
+                 "--baseline", bl]) == 0
+
+
+# ========================================================== zoo audit
+
+
+@needs_8_devices
+def test_zoo_audit_zero_errors_nonempty_reports():
+    """The model-zoo audit: MLP, resnet8, transformer and the TP mesh
+    module produce zero ERROR findings, non-empty remat reports for the
+    nets and a non-empty comm report for the mesh module."""
+    from mxnet_tpu.analysis.__main__ import _zoo_symbol
+    for name in ("mlp", "resnet8", "transformer"):
+        net, shapes = _zoo_symbol(name)
+        report = analyze_symbol(net, input_shapes=shapes, context=name)
+        assert not report.errors, report.format(Severity.ERROR)
+        assert report.extras.get("remat", {}).get("candidates"), \
+            "%s: remat report empty" % name
+    mod = _tp_module()
+    report = analyze_module_sharding(mod)
+    assert not report.errors, report.format(Severity.ERROR)
+    assert report.extras["comm"]["collectives"]
+
+
+@needs_8_devices
+def test_cli_audit_default_targets():
+    from mxnet_tpu.analysis.__main__ import main
+    assert main(["audit"]) == 0
+
+
+def test_cli_audit_single_zoo_target(capsys):
+    from mxnet_tpu.analysis.__main__ import main
+    assert main(["audit", "transformer"]) == 0
+    out = capsys.readouterr().out
+    assert "remat:" in out and "suggestion:" in out and "roofline:" in out
+
+
+def test_cli_audit_accepts_zoo_prefix():
+    from mxnet_tpu.analysis.__main__ import main
+    assert main(["audit", "zoo:mlp"]) == 0
+
+
+@needs_8_devices
+def test_axis_groups_prefer_smallest_subset():
+    """On a mesh with a size-1 axis the ('model',) and ('data','model')
+    replica groups coincide; attribution must pick the axis users grep
+    for, not the multi-axis key."""
+    from mxnet_tpu.analysis.sharding_passes import _axis_groups
+    mesh = make_mesh({"data": 1, "model": 8})
+    groups = frozenset([frozenset(range(8))])
+    assert _axis_groups(mesh)[groups] == ("model",)
+
+
+def test_shape_bytes_async_start_tuple():
+    """Async *-start collectives return (operand-alias, result[, ctx])
+    tuples; only the result buffer moves — summing double-counts."""
+    from mxnet_tpu.analysis.sharding_passes import _shape_bytes
+    tup = "(f32[64,2]{1,0}, f32[64,2]{1,0}, u32[], u32[])"
+    assert _shape_bytes(tup, largest_only=True) == 64 * 2 * 4
+    assert _shape_bytes("f32[64,2]{1,0}") == 64 * 2 * 4
