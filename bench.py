@@ -28,11 +28,26 @@ artifact (round 5: rc 124 left BENCH_r05.json empty). Output protocol:
 Consumers that take the last line keep working; consumers that want
 partial results on a wedge read the section lines.
 Per-section timeout: $BENCH_SECTION_TIMEOUT_SECS (default 600).
+
+Round-9 wedge-class fix: sections additionally run every bind/compile
+under a PhaseGuard — a hard per-phase deadline
+($BENCH_BIND_TIMEOUT_SECS, default 300) INSIDE the section process that,
+on expiry, prints a partial record carrying the phase name and the
+bind_secs burned so far, then exits 124. The round-5 failure mode ("
+resnet bind start" then 25 silent minutes, whole section lost) now
+leaves a diagnosable partial line, and the parent keeps partial records
+from non-zero-rc sections instead of discarding their stdout.
+
+Compile-time levers (ISSUE 9) measured here: the transformer section
+binds with scan-over-layers on AND off to record the bind/first-step
+delta; the resnet_remat_accum section retries ResNet at 2x batch with
+MXNET_TPU_REMAT=auto + grad_accum=2 (HBM headroom -> MFU).
 """
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -40,6 +55,73 @@ def _note(msg):
     print(msg, file=sys.stderr, flush=True)
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0] if "/" in __file__ else ".")
+
+
+class PhaseGuard:
+    """Hard per-phase deadline inside a section process.
+
+    ``with guard.phase("bind"):`` arms a watchdog; if the phase is still
+    running after ``timeout`` seconds the guard prints ``rec`` (the
+    section's partial record, filled incrementally) plus the phase name
+    and elapsed seconds as the section's ONLY record line, then
+    ``os._exit(124)`` — the parent keeps this partial line, so a wedged
+    bind no longer erases the measurements that preceded it."""
+
+    def __init__(self, section, rec, timeout=None):
+        self.section = section
+        self.rec = rec
+        self.timeout = float(timeout if timeout is not None else
+                             os.environ.get("BENCH_BIND_TIMEOUT_SECS",
+                                            "300"))
+        self._deadline = None
+        self._name = None
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._watch, daemon=True,
+                             name="bench-phase-guard")
+        t.start()
+
+    def _watch(self):
+        while True:
+            time.sleep(0.5)
+            with self._lock:
+                dl, name = self._deadline, self._name
+            if dl is None:
+                continue
+            now = time.perf_counter()
+            if now >= dl:
+                out = dict(self.rec)
+                out["section"] = self.section
+                out["phase"] = name
+                out["phase_elapsed_secs"] = round(now - (dl - self.timeout),
+                                                  1)
+                # only fill bind_secs when the bind itself is what
+                # wedged — a completed arm's real measurement in rec
+                # must survive (the whole point of the partial record)
+                out.setdefault("bind_secs", out["phase_elapsed_secs"])
+                out["error"] = "phase %r exceeded %ds" % (name,
+                                                          self.timeout)
+                print(json.dumps(out), flush=True)
+                os._exit(124)
+
+    class _Phase:
+        def __init__(self, guard, name):
+            self.guard, self.name = guard, name
+
+        def __enter__(self):
+            with self.guard._lock:
+                self.guard._name = self.name
+                self.guard._deadline = time.perf_counter() + \
+                    self.guard.timeout
+            return self
+
+        def __exit__(self, *exc):
+            with self.guard._lock:
+                self.guard._deadline = None
+            return False
+
+    def phase(self, name):
+        return PhaseGuard._Phase(self, name)
+
 
 BASELINE_IMG_S = 181.53   # P100 training, ResNet-50 batch 32
 # Round-6 shrink: round 5 timed out (rc 124) with the resnet section at
@@ -51,7 +133,7 @@ BASELINE_IMG_S = 181.53   # P100 training, ResNet-50 batch 32
 BATCH = 128
 WARMUP = 2
 ITERS = 12
-SECTIONS = ("resnet", "transformer")
+SECTIONS = ("resnet", "resnet_remat_accum", "transformer")
 
 # Analytic model FLOPs: ResNet-50 @224x224 forward = 4.089e9 multiply-adds
 # (= 8.18 GFLOP at 2 FLOPs/MAC); training step ~ 3x forward (fwd + 2x in bwd).
@@ -95,7 +177,11 @@ def _obs_crosscheck():
 
 
 def section_transformer():
-    """Transformer-LM fused train step: tokens/s + MFU on one chip."""
+    """Transformer-LM fused train step: tokens/s + MFU on one chip, and
+    the deep-model compile-time delta: bind + first-step wall with
+    scan-over-layers ON (the default) vs OFF (unrolled), each arm under
+    its own PhaseGuard so a wedged unrolled bind cannot erase the scan
+    numbers (the round-5 wedge class)."""
     import numpy as np
     import jax
     import mxnet_tpu as mx
@@ -112,31 +198,48 @@ def section_transformer():
     # the HBM ceiling with f32 master weights.
     L, D, H, T, V = 12, 2048, 16, 1024, 32000
     B = 8
-    _note("bench: transformer bind start")
-    t_bind = time.perf_counter()
+    rec = {}
+    guard = PhaseGuard("transformer", rec)
     sym = transformer.get_symbol(vocab_size=V, num_layers=L, d_model=D,
                                  n_heads=H, seq_len=T, attention="flash")
-    mod = mx.mod.Module(sym, context=mx.tpu(0))
-    mod.bind(data_shapes=[("data", (B, T))],
-             label_shapes=[("softmax_label", (B, T))])
-    mod.init_params(mx.init.Xavier())
-    mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.01})
-    bind_secs = round(time.perf_counter() - t_bind, 3)
     rng = np.random.RandomState(0)
     x = rng.randint(0, V, (B, T)).astype(np.float32)
     y = rng.randint(0, V, (B, T)).astype(np.float32)
-    db = mx.io.DataBatch(data=[mx.nd.array(x, ctx=mx.tpu(0))],
-                         label=[mx.nd.array(y, ctx=mx.tpu(0))])
+
+    def build_and_first_step(scan_mode, phase):
+        mx.config.set("MXNET_TPU_SCAN_LAYERS", scan_mode)
+        _note("bench: transformer bind start (scan=%s)" % scan_mode)
+        with guard.phase(phase):
+            t_bind = time.perf_counter()
+            mod = mx.mod.Module(sym, context=mx.tpu(0))
+            mod.bind(data_shapes=[("data", (B, T))],
+                     label_shapes=[("softmax_label", (B, T))])
+            mod.init_params(mx.init.Xavier())
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.01})
+            bind_secs = round(time.perf_counter() - t_bind, 3)
+            db = mx.io.DataBatch(data=[mx.nd.array(x, ctx=mx.tpu(0))],
+                                 label=[mx.nd.array(y, ctx=mx.tpu(0))])
+            _note("bench: transformer bound in %.1fs; compiling" % bind_secs)
+            t0 = time.perf_counter()
+            mod._fit_step(db)
+            float(np.asarray(
+                mod._exec.arg_dict["lm_head_weight"].data[0, 0]))
+            first_step = round(time.perf_counter() - t0, 3)
+        return mod, db, bind_secs, first_step
+
+    mod, db, bind_on, first_on = build_and_first_step("auto", "bind-scan")
+    rec["bind_secs"] = bind_on
+    rec["first_step_secs"] = first_on
+    rec["scan_layers"] = mx.profiler.gauges().get("scan_layers")
 
     def drain():
         return float(np.asarray(
             mod._exec.arg_dict["lm_head_weight"].data[0, 0]))
 
-    _note("bench: transformer bound; compiling")
-    for _ in range(2):
+    with guard.phase("warmup"):
         mod._fit_step(db)
-    drain()
+        drain()
     mx.obs.report()     # open the obs rate window at the timed region
     _note("bench: transformer timing")
     iters = 12
@@ -151,13 +254,31 @@ def section_transformer():
     n_embed = V * D + T * D
     flops_per_tok = 6 * (n_params - n_embed) + 12 * L * D * T
     mfu = round(tok_s * flops_per_tok / peak, 4) if peak else None
-    rec = {"transformer_tok_s": round(tok_s, 1), "transformer_mfu": mfu,
-           "bind_secs": bind_secs}
+    rec.update({"transformer_tok_s": round(tok_s, 1),
+                "transformer_mfu": mfu})
     rec.update(_obs_crosscheck())
+
+    # the unrolled control arm LAST (it is the wedge-prone one — round 5
+    # died in exactly this bind); its guard exit keeps everything above
+    if os.environ.get("BENCH_SCAN_OFF_ARM", "1") != "0":
+        del mod, db
+        try:
+            _, _, bind_off, first_off = build_and_first_step(
+                "off", "bind-unrolled")
+            rec["bind_secs_scan_off"] = bind_off
+            rec["first_step_secs_scan_off"] = first_off
+            on, off = bind_on + first_on, bind_off + first_off
+            rec["scan_bind_speedup"] = round(off / on, 2) if on else None
+        finally:
+            mx.config.set("MXNET_TPU_SCAN_LAYERS", "auto")
     return rec
 
 
-def section_resnet():
+def _resnet_run(rec, batch, iters, grad_accum=None, remat=None,
+                section="resnet"):
+    """Shared ResNet-50 bf16 driver: bind (phase-guarded), warm up, time
+    the fused step, fill ``rec`` in place (partial values survive a
+    guard exit)."""
     import numpy as np
     import jax
     import mxnet_tpu as mx
@@ -165,27 +286,31 @@ def section_resnet():
 
     on_tpu = bool(mx.num_devices("tpu"))
     ctx = mx.tpu(0) if on_tpu else mx.cpu(0)
-    batch = BATCH if on_tpu else 8
-    iters = ITERS if on_tpu else 3
+    guard = PhaseGuard(section, rec)
 
     mx.amp.init("bfloat16")   # bf16 MXU compute, fp32 master weights
-    _note("bench: resnet bind start")
-    t_bind = time.perf_counter()
-
-    # space-to-depth stem: mathematically identical to the 7x7/2 stem
-    # on the same parameter, ~2 ms/step faster (docs/perf.md round-5
-    # restructuring sweep)
-    sym = resnet.get_symbol(num_classes=1000, num_layers=50, stem="s2d")
-    mod = mx.mod.Module(sym, context=ctx)
-    mod.bind(data_shapes=[("data", (batch, 3, 224, 224))],
-             label_shapes=[("softmax_label", (batch,))])
-    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
-                                   magnitude=2))
-    mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.05,
-                                         "momentum": 0.9, "wd": 1e-4})
-    bind_secs = round(time.perf_counter() - t_bind, 3)
-    _note("bench: resnet bound in %.1fs" % bind_secs)
+    if remat is not None:
+        mx.config.set("MXNET_TPU_REMAT", remat)
+    _note("bench: %s bind start" % section)
+    with guard.phase("bind"):
+        t_bind = time.perf_counter()
+        # space-to-depth stem: mathematically identical to the 7x7/2
+        # stem on the same parameter, ~2 ms/step faster (docs/perf.md
+        # round-5 restructuring sweep)
+        sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                                stem="s2d")
+        mod = mx.mod.Module(sym, context=ctx)
+        mod.bind(data_shapes=[("data", (batch, 3, 224, 224))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2))
+        if grad_accum:
+            mod.set_grad_accum(grad_accum)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9, "wd": 1e-4})
+        rec["bind_secs"] = round(time.perf_counter() - t_bind, 3)
+    _note("bench: %s bound in %.1fs" % (section, rec["bind_secs"]))
 
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
@@ -203,13 +328,17 @@ def section_resnet():
         return float(np.asarray(
             mod._exec.arg_dict["fc1_weight"].data[0, 0]))
 
-    _note("bench: resnet compiling")
-    for _ in range(WARMUP):
-        mod._fit_step(dbatch)
-    drain()
+    _note("bench: %s compiling" % section)
+    with guard.phase("compile"):
+        t0 = time.perf_counter()
+        for _ in range(WARMUP):
+            mod._fit_step(dbatch)
+        drain()
+        rec["first_step_secs"] = round(time.perf_counter() - t0, 3)
     mx.obs.report()     # open the obs rate window at the timed region
-    _note("bench: resnet timing")
+    _note("bench: %s timing" % section)
 
+    rc0 = mx.profiler.counters().get("loop_recompile", 0)
     t0 = time.perf_counter()
     for _ in range(iters):
         mod._fit_step(dbatch)
@@ -219,7 +348,8 @@ def section_resnet():
     img_s = batch * iters / dt
     peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else None
     mfu = round(img_s * TRAIN_FLOPS_PER_IMG / peak, 4) if peak else None
-    rec = {
+    counters = mx.profiler.counters()
+    rec.update({
         "metric": "resnet50_train_bf16",
         "value": round(img_s, 2),
         "unit": "img/s",
@@ -228,14 +358,41 @@ def section_resnet():
         "batch": batch,
         "flops_per_img": TRAIN_FLOPS_PER_IMG,
         "peak_flops": peak,
-        "bind_secs": bind_secs,
-    }
+        # steady-state recompiles are a bug; record the timed window's
+        # delta so the acceptance gate can counter-assert zero
+        "loop_recompile": counters.get("loop_recompile", 0) - rc0,
+        "remat_applied": counters.get("remat_applied", 0),
+        "accum_steps": counters.get("accum_steps", 0),
+    })
     rec.update(_obs_crosscheck())
     return rec
 
 
+def section_resnet():
+    on_tpu_batch = BATCH
+    import mxnet_tpu as mx
+    on_tpu = bool(mx.num_devices("tpu"))
+    batch = on_tpu_batch if on_tpu else 8
+    iters = ITERS if on_tpu else 3
+    return _resnet_run({}, batch, iters, section="resnet")
+
+
+def section_resnet_remat_accum():
+    """The ISSUE 9 memory levers applied: 2x the round-5 batch, fit in
+    HBM via auto-remat + 2-way gradient accumulation, MFU vs the 0.29
+    plain-batch baseline."""
+    import mxnet_tpu as mx
+    on_tpu = bool(mx.num_devices("tpu"))
+    if not on_tpu:
+        return {"skipped": "no tpu attached"}
+    return _resnet_run({}, 2 * BATCH, ITERS, grad_accum=2, remat="auto",
+                       section="resnet_remat_accum")
+
+
 def run_section(name):
-    fn = {"resnet": section_resnet, "transformer": section_transformer}[name]
+    fn = {"resnet": section_resnet,
+          "resnet_remat_accum": section_resnet_remat_accum,
+          "transformer": section_transformer}[name]
     rec = dict(fn())
     rec["section"] = name
     print(json.dumps(rec), flush=True)
@@ -249,19 +406,32 @@ def _merge(records):
         "vs_baseline": None, "mfu": None, "batch": None,
         "flops_per_img": TRAIN_FLOPS_PER_IMG, "peak_flops": None,
         "transformer_tok_s": None, "transformer_mfu": None,
+        "resnet_remat_accum_mfu": None, "resnet_remat_accum_img_s": None,
+        "scan_bind_speedup": None,
         "bind_secs": {},
+        "first_step_secs": {},
         "obs_mfu": {},
         "obs_bind_ms_total": {},
     }
-    _per_section = ("bind_secs", "obs_mfu", "obs_bind_ms_total")
+    _per_section = ("bind_secs", "first_step_secs", "obs_mfu",
+                    "obs_bind_ms_total")
     errors = {}
     for name, rec in records.items():
-        if "error" in rec:
+        if "error" in rec and not any(
+                rec.get(k) is not None for k in _per_section):
             errors[name] = rec["error"]
             continue
-        for k in merged:
-            if k not in _per_section and k in rec:
-                merged[k] = rec[k]
+        if "error" in rec:
+            # partial record (PhaseGuard exit): keep its measurements
+            # AND surface the error
+            errors[name] = rec["error"]
+        if name == "resnet_remat_accum":
+            merged["resnet_remat_accum_mfu"] = rec.get("mfu")
+            merged["resnet_remat_accum_img_s"] = rec.get("value")
+        else:
+            for k in merged:
+                if k not in _per_section and k in rec:
+                    merged[k] = rec[k]
         for k in _per_section:
             # per-section records: the round-5 wedge was a 25-min bind,
             # invisible in a throughput-only record; obs_mfu is the
@@ -289,12 +459,26 @@ def main():
                 timeout=timeout, stdout=subprocess.PIPE, text=True)
             lines = [l for l in (proc.stdout or "").splitlines()
                      if l.strip()]
-            if proc.returncode != 0:
+            parsed = None
+            for line in reversed(lines):
+                try:
+                    candidate = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(candidate, dict):
+                    parsed = candidate
+                    break
+            if parsed is not None:
+                # keep partial records from non-zero exits too: a
+                # PhaseGuard bind-timeout exit (rc 124) prints the
+                # section's measurements so far — round 5 lost them
+                rec = parsed
+                if proc.returncode != 0:
+                    rec.setdefault("error", "rc %d" % proc.returncode)
+            elif proc.returncode != 0:
                 rec["error"] = "rc %d" % proc.returncode
-            elif not lines:
-                rec["error"] = "no output"
             else:
-                rec = json.loads(lines[-1])
+                rec["error"] = "no output"
         except subprocess.TimeoutExpired:
             # the wedge case: this section hung; its sibling sections
             # still run and still report

@@ -1,0 +1,300 @@
+"""AOT warm starts: serialized executables so restarts skip compilation.
+
+Two independent layers, both fenced against the known jax bug where a
+**deserialized multi-device executable mis-executes** on this jax/XLA
+version (root-caused in PR 2: collective-bearing CPU executables loaded
+from the persistent compile cache intermittently compute wrong results
+— diffs ~2.0 with a warm cache, zero with a cold one):
+
+1. **Executable cache** (``MXNET_TPU_COMPILE_CACHE=<dir>``): the fused
+   train step and the executor forward serialize their compiled
+   executables (``jax.experimental.serialize_executable``) keyed on the
+   framework-level program signature — symbol JSON, bound
+   shapes/dtypes, optimizer statics, compile-affecting knobs, and the
+   jax/device fingerprint — so a restarted ``fit``/``serve`` process
+   skips trace AND lower AND backend-compile for warm programs
+   (``aot_hit``; the CI ``compile-time`` job asserts a warm second
+   process records zero backend-compile phases for the fused step in
+   the obs compile accounting). Single-device programs only
+   (``aot_skip_multidevice``), and only after :func:`supported` proves
+   a serialize → deserialize → execute → compare round-trip on this
+   backend (``aot_unsupported``).
+
+2. **Persistent-cache fence** (:func:`install_persistent_cache_fence`):
+   jax's own persistent compile cache (``MXNET_COMPILATION_CACHE_DIR``,
+   ``tests/.jax_cache``) gets a root-cause fence instead of the old
+   conftest module-name exclusion: the cache get/put entry points skip
+   any executable whose ``num_replicas * num_partitions > 1``
+   (``compile_cache_fence_skip``), so multi-device programs always
+   compile fresh while single-device programs keep warm starts
+   everywhere. Fail-closed: anything unexpected about the compile
+   options skips the cache (a fresh compile is always correct).
+
+Layout: one ``<name>-<sha256>.aotx`` pickle per executable (payload +
+pytree defs + fingerprint), written atomically (`checkpoint.atomic`) so
+a killed process can never tear an entry. A corrupt or
+wrong-fingerprint entry is a miss, never an error.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+from . import profiler as _profiler
+
+__all__ = [
+    "enabled", "supported", "fingerprint", "digest", "load", "store",
+    "install_persistent_cache_fence",
+]
+
+log = logging.getLogger(__name__)
+
+_FORMAT_VERSION = 1
+_probe_result: Optional[bool] = None
+
+
+def enabled() -> Optional[str]:
+    """The executable-cache directory, or None when the knob is off."""
+    from . import config as _config
+    d = _config.get("MXNET_TPU_COMPILE_CACHE")
+    return d or None
+
+
+# knobs ops read at TRACE time: their value is baked into the compiled
+# program, so they must invalidate serialized executables (a stale
+# entry would silently run the other variant of the op)
+_TRACE_KNOBS = ("MXNET_TPU_LAYERNORM_TWO_PASS",)
+
+
+def fingerprint() -> str:
+    """Everything that invalidates a serialized executable wholesale:
+    jax/jaxlib versions, backend platform + device kind, XLA flags,
+    trace-time op knobs, and the framework version (op implementations
+    change programs)."""
+    import jax
+    import jaxlib
+    from . import __version__ as mx_version
+    from . import config as _config
+    dev = jax.devices()[0]
+    parts = (
+        "v%d" % _FORMAT_VERSION, jax.__version__, jaxlib.__version__,
+        jax.default_backend(), getattr(dev, "device_kind", "?"),
+        os.environ.get("XLA_FLAGS", ""), mx_version,
+    ) + tuple("%s=%r" % (k, _config.get(k)) for k in _TRACE_KNOBS)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def supported() -> bool:
+    """Capability probe, once per process: serialize a trivial compiled
+    program, deserialize it, execute it, and compare values. A backend
+    or jax build where the round-trip is unavailable or wrong disables
+    the executable cache entirely (``aot_unsupported``)."""
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    # unlocked on purpose: a racing second probe just repeats the same
+    # idempotent round-trip (holding a mutex across jax dispatch is the
+    # lock-dispatch hazard the repo lint rejects)
+    try:
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load, serialize)
+
+        # salt the probe program so it can never be served from jax's
+        # persistent compile cache: a cache-LOADED executable does not
+        # re-serialize on this backend ("Symbols not found") — that case
+        # is handled per-store by the verify in store(), and must not
+        # fail the whole capability probe
+        salt = float(int.from_bytes(os.urandom(4), "big")) / 2**32 + 2.0
+        fn = jax.jit(lambda x: x * salt + 1.0)
+        x = jnp.arange(8, dtype=jnp.float32)
+        compiled = fn.lower(x).compile()
+        blob = pickle.dumps(serialize(compiled))
+        loaded = deserialize_and_load(*pickle.loads(blob))
+        ok = bool(np.array_equal(np.asarray(loaded(x)),
+                                 np.asarray(fn(x))))
+    except Exception:                                       # noqa: BLE001
+        ok = False
+    if not ok:
+        _profiler.incr_counter("aot_unsupported")
+        log.warning(
+            "MXNET_TPU_COMPILE_CACHE: executable serialization "
+            "round-trip failed on this jax/backend; AOT warm starts "
+            "disabled")
+    _probe_result = ok
+    return ok
+
+
+def digest(parts: Iterable[Any]) -> str:
+    """Collision-resistant digest of the program signature parts (the
+    caller supplies symbol JSON, shapes/dtypes, optimizer statics,
+    knobs); the device/jax fingerprint is always mixed in."""
+    h = hashlib.sha256(fingerprint().encode())
+    for p in parts:
+        h.update(b"\x00")
+        h.update(repr(p).encode())
+    return h.hexdigest()
+
+
+def _path(directory: str, name: str, key: str) -> str:
+    return os.path.join(directory, "%s-%s.aotx" % (name, key))
+
+
+def load(name: str, key: str) -> Optional[Callable]:
+    """Deserialize the cached executable for ``(name, key)``; a missing,
+    corrupt, or wrong-fingerprint entry is a miss (``aot_miss``)."""
+    directory = enabled()
+    if directory is None or not supported():
+        return None
+    path = _path(directory, name, key)
+    try:
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+        if entry.get("version") != _FORMAT_VERSION or \
+                entry.get("fingerprint") != fingerprint():
+            raise ValueError("stale entry")
+        from jax.experimental.serialize_executable import \
+            deserialize_and_load
+        loaded = deserialize_and_load(entry["payload"], entry["in_tree"],
+                                      entry["out_tree"])
+    except FileNotFoundError:
+        _profiler.incr_counter("aot_miss")
+        return None
+    except Exception as exc:                                # noqa: BLE001
+        _profiler.incr_counter("aot_miss")
+        log.info("aot: ignoring unusable cache entry %s (%s)", path, exc)
+        return None
+    _profiler.incr_counter("aot_hit")
+    return loaded
+
+
+def store(name: str, key: str, compiled) -> bool:
+    """Serialize ``compiled`` under ``(name, key)``, atomically
+    (``aot_store``). Serialization failures only cost the warm start."""
+    directory = enabled()
+    if directory is None or not supported():
+        return False
+    try:
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load, serialize)
+        payload, in_tree, out_tree = serialize(compiled)
+        # verify the payload actually deserializes before persisting:
+        # an executable that was itself loaded from jax's persistent
+        # compile cache serializes "successfully" but its payload lacks
+        # the kernel symbols ("Symbols not found" on load) — storing it
+        # would cost every future process an aot_error round
+        deserialize_and_load(payload, in_tree, out_tree)
+        entry = {
+            "version": _FORMAT_VERSION, "fingerprint": fingerprint(),
+            "name": name, "payload": payload,
+            "in_tree": in_tree, "out_tree": out_tree,
+        }
+        os.makedirs(directory, exist_ok=True)
+        from .checkpoint.atomic import atomic_open
+        with atomic_open(_path(directory, name, key), "wb") as f:
+            pickle.dump(entry, f)
+    except Exception as exc:                                # noqa: BLE001
+        _profiler.incr_counter("aot_store_unverified")
+        log.warning("aot: could not serialize %s: %s", name, exc)
+        return False
+    _profiler.incr_counter("aot_store")
+    return True
+
+
+# ------------------------------------------------- persistent-cache fence
+
+_fence_lock = threading.Lock()
+_fence_installed = False
+_tls = threading.local()
+
+
+class bypass_persistent_cache:
+    """Compile fresh, ignoring jax's persistent compile cache, on this
+    thread. The AOT store path needs this: an executable jax loaded from
+    its persistent cache serializes to a payload without kernel symbols
+    (unloadable), so the one compile that seeds the executable cache
+    must be a real backend compile. Requires the fence (best-effort
+    installed on entry); without it the bypass is a no-op and
+    ``store()``'s deserialize-verify refuses the bad payload instead."""
+
+    def __enter__(self):
+        install_persistent_cache_fence()
+        _tls.bypass = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.bypass = False
+        return False
+
+
+def install_persistent_cache_fence() -> bool:
+    """Fence jax's persistent compile cache to single-device executables.
+
+    Root cause (PR 2): on this jax/XLA version a deserialized
+    multi-device (collective-bearing) CPU executable intermittently
+    mis-executes; the conftest used to exclude whole test modules from
+    the cache by NAME. This fence moves the exclusion to the actual
+    hazard: the cache's get/put entry points skip any program whose
+    compile options say ``num_replicas * num_partitions > 1``
+    (``compile_cache_fence_skip``), and anything unexpected about the
+    options **fails closed** (skip the cache — a fresh compile is
+    always correct). Idempotent; returns False when the jax internals
+    drifted past the capability probe (callers should then disable the
+    persistent cache wholesale)."""
+    global _fence_installed
+    with _fence_lock:
+        if _fence_installed:
+            return True
+        try:
+            from jax._src import compilation_cache as cc
+            orig_get = cc.get_executable_and_time
+            orig_put = cc.put_executable_and_time
+            if not callable(orig_get) or not callable(orig_put):
+                raise TypeError("compilation_cache API drifted")
+        except Exception:                                   # noqa: BLE001
+            log.warning("persistent-cache fence: jax internals drifted; "
+                        "NOT installed — disable the persistent cache "
+                        "for multi-device work")
+            return False
+
+        def _multi(compile_options) -> bool:
+            try:
+                ebo = compile_options.executable_build_options
+                return int(ebo.num_replicas) * int(ebo.num_partitions) > 1
+            except Exception:                               # noqa: BLE001
+                return True        # fail closed: treat as multi-device
+
+        def fenced_get(cache_key, compile_options, backend):
+            if getattr(_tls, "bypass", False):
+                return None, None     # AOT seeding compile: stay fresh
+            if _multi(compile_options):
+                _profiler.incr_counter("compile_cache_fence_skip")
+                return None, None
+            return orig_get(cache_key, compile_options, backend)
+
+        def fenced_put(cache_key, module_name, executable, backend,
+                       compile_time):
+            # the get fence is the correctness fence (nothing skipped
+            # here is ever loaded); skipping the put as well keeps the
+            # cache free of unusable multi-device entries
+            try:
+                multi = int(getattr(executable, "num_replicas", 1)) * \
+                    int(getattr(executable, "num_partitions", 1)) > 1
+            except Exception:                               # noqa: BLE001
+                multi = True
+            if multi:
+                _profiler.incr_counter("compile_cache_fence_skip")
+                return None
+            return orig_put(cache_key, module_name, executable, backend,
+                            compile_time)
+
+        cc.get_executable_and_time = fenced_get
+        cc.put_executable_and_time = fenced_put
+        _fence_installed = True
+        return True

@@ -179,6 +179,53 @@ register("MXNET_TPU_OBS_PEAK_FLOPS", float, 0.0,
          "mx.obs: override the device's peak dense FLOP/s used for the "
          "obs_mfu gauge (0 = auto-detect by TPU device_kind; set "
          "explicitly on unknown devices or in tests)")
+def _parse_scan_layers(v) -> str:
+    s = str(v).strip().lower()
+    if s in ("", "0", "off", "false", "no", "none"):
+        return "off"
+    if s in ("auto", "on", "true", "yes", "1"):
+        return "auto"
+    if s.isdigit() and int(s) >= 2:
+        return s
+    raise ValueError(
+        "MXNET_TPU_SCAN_LAYERS must be off|auto|<min-repeat >= 2>, "
+        "got %r" % (v,))
+
+
+register("MXNET_TPU_SCAN_LAYERS", _parse_scan_layers, "auto",
+         "scan-over-layers: lower repeated homogeneous blocks "
+         "(transformer layers) through jax.lax.scan so trace/compile "
+         "time stops growing with depth; auto = chains of >= 4 verified-"
+         "isomorphic blocks, an integer overrides that minimum, off = "
+         "always unroll (the scan module is never imported)")
+
+
+def _parse_remat(v) -> str:
+    s = str(v).strip()
+    low = s.lower()
+    if low in ("", "0", "off", "false", "no", "none"):
+        return "off"
+    if low == "auto":
+        return "auto"
+    return s   # a jax.checkpoint_policies name, validated at use
+
+
+register("MXNET_TPU_REMAT", _parse_remat, "off",
+         "applied rematerialization for the fused train step: off = "
+         "save all activations, auto = apply the policy the analysis "
+         "remat-opportunity pass suggests for this graph "
+         "(Report.extras['remat']), any other value = a "
+         "jax.checkpoint_policies name applied as-is (e.g. "
+         "nothing_saveable, dots_with_no_batch_dims_saveable)")
+register("MXNET_TPU_COMPILE_CACHE", str, "",
+         "AOT warm starts: directory for serialized fused-step "
+         "executables keyed on the program signature (symbol + shapes + "
+         "dtypes + optimizer statics + compile knobs + jax/device "
+         "fingerprint) so a restarted process skips trace AND compile. "
+         "SINGLE-DEVICE executables only (deserialized multi-device "
+         "executables mis-execute on this jax version — the fence is "
+         "capability-probed, see docs/architecture/program_model.md). "
+         "Empty = off")
 register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
          "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
          "one-pass E[x^2]-E[x]^2 form — restores precision for "

@@ -69,7 +69,13 @@ class Parameter(object):
         self._grad_req = req
         if req == "null":
             self._grad = None
-        elif self._data is not None and self._grad is None:
+        elif self._data is not None:
+            # re-mark even when a grad buffer already exists: the tape
+            # keeps the req it was marked with, so switching an
+            # initialized parameter write->add (the gradient-
+            # accumulation idiom) must re-register or backward() keeps
+            # overwriting (the fresh zero grad matches the reference's
+            # re-alloc semantics)
             self._init_grad()
 
     # ------------------------------------------------------------- init

@@ -251,7 +251,8 @@ class BaseModule(object):
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, checkpoint=None, resume_from=None):
+            monitor=None, checkpoint=None, resume_from=None,
+            grad_accum=None):
         """Train the module (reference: base_module.py:376 — the canonical
         forward_backward → update → update_metric loop with epoch/batch
         callbacks and checkpointing hooks).
@@ -284,6 +285,15 @@ class BaseModule(object):
         (counter-asserted: ``loop_host_sync``). A monitor, a host-callback
         CustomOp program, or ``MXNET_TPU_ASYNC_WINDOW=0`` falls back to
         the fully synchronous per-batch loop.
+
+        ``grad_accum=N`` (docs/architecture/program_model.md,
+        compile-time control): microbatch gradient accumulation — the
+        fused step splits every batch into N equal microbatches run
+        through one ``lax.scan`` with gradient carry, so only one
+        microbatch's activations are live at a time while the optimizer
+        sees the exact full-batch gradient (BatchNorm statistics advance
+        per microbatch). Requires a module with a fused step and
+        N | batch size.
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
@@ -324,6 +334,17 @@ class BaseModule(object):
                              resume.path, resume.step, begin_epoch,
                              ", batch %d" % resume.batches_done
                              if resume.mid_epoch else "")
+
+        if grad_accum is not None:
+            setter = getattr(self, "set_grad_accum", None)
+            if setter is not None:
+                # before init_optimizer so the fused step builds with it
+                setter(grad_accum)
+            elif int(grad_accum) > 1:
+                raise MXNetError(
+                    "fit(grad_accum=%s): %s does not support microbatch "
+                    "gradient accumulation" % (grad_accum,
+                                               type(self).__name__))
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,

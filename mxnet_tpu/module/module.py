@@ -46,6 +46,43 @@ __all__ = ["Module"]
 _snapshot_copy = jax.jit(lambda xs: [jnp.copy(x) for x in xs])
 
 
+def _accum_loss_scale(symbol, accum: int) -> float:
+    """Gradient rescale that makes an N-microbatch accumulated step
+    match the unaccumulated full-batch step.
+
+    Loss-head backward contract (ops/nn.py): ``normalization='null'``
+    (and the regression/SVM heads, and plain outputs driven by
+    ones-cotangents) produce **per-sample** gradients — summing the N
+    microbatch gradients IS the full-batch gradient, scale 1.
+    ``normalization='batch'`` divides by the (micro)batch size, so the
+    accumulated sum is N x the full-batch mean — scale 1/N (equal-sized
+    microbatches make the mean-of-means exact).
+    ``normalization='valid'`` divides by a data-dependent count per
+    microbatch; no uniform rescale reproduces the full-batch step, so
+    it is rejected, as is a mix of batch-mean and per-sample heads."""
+    kinds = set()
+    for node, _ in symbol._entries:
+        if node.is_variable:
+            kinds.add("sample")
+            continue
+        norm = node.attrs.get("normalization")
+        if norm == "valid":
+            raise MXNetError(
+                "grad_accum: %s head %r uses normalization='valid' "
+                "(a per-batch valid count cannot be replayed per "
+                "microbatch) — use 'batch' or 'null'"
+                % (node.op.name, node.name))
+        kinds.add("batch" if norm == "batch" else "sample")
+    if kinds == {"batch"}:
+        return 1.0 / accum
+    if "batch" in kinds:
+        raise MXNetError(
+            "grad_accum: loss heads mix batch-mean and per-sample/sum "
+            "normalization; the accumulated gradient cannot be rescaled "
+            "consistently — align the heads' normalization")
+    return 1.0
+
+
 class Module(BaseModule):
     """A bound Symbol + parameters + optimizer (reference: module.py:39)."""
 
@@ -109,6 +146,7 @@ class Module(BaseModule):
         self._preload_opt_states = None
 
         self._exec: Optional[Executor] = None
+        self._grad_accum = 1
         self._data_shapes = None
         self._label_shapes = None
         self._grad_req = None
@@ -674,10 +712,12 @@ class Module(BaseModule):
         """
         if self._updater is None and not self._update_on_kvstore:
             self._fused = None
+            self._check_accum_needs_fused()
             return
         if self._update_on_kvstore and self._kvstore is not None \
                 and "dist" in self._kvstore.type:
             self._fused = None  # real parameter-server path: not fusable
+            self._check_accum_needs_fused()
             return
 
         optimizer = self._optimizer
@@ -718,36 +758,101 @@ class Module(BaseModule):
             return states
 
         from .. import config as _config
-        remat = _config.get("MXNET_EXEC_ENABLE_REMAT")
+        # ---- applied rematerialization (MXNET_TPU_REMAT; legacy alias
+        # MXNET_EXEC_ENABLE_REMAT). With a scan plan bound, the executor
+        # already wrapped each repeated block — exactly the granularity
+        # the remat-opportunity suggestion prescribes — so only the
+        # plan-less flat trace is wrapped here (whole-forward form).
+        # Historical caveat (tools/perf/doc_evidence.py, note_memory.md):
+        # on dense-attention transformers the flat save-policy form cuts
+        # little (the T^2 score tensors must exist during the backward
+        # recompute anyway); the per-block form over a scan plan is the
+        # one that recovers residual-stream activations.
+        remat_policy = None
+        remat_name = getattr(self._exec, "_remat_name", "off")
+        if remat_name == "off" and (
+                _config.get("MXNET_TPU_REMAT") != "off"
+                or _config.get("MXNET_EXEC_ENABLE_REMAT")):
+            from .. import remat as _remat
+            shapes = {n: tuple(a.shape)
+                      for n, a in self._exec.arg_dict.items()}
+            shapes.update({n: tuple(a.shape)
+                           for n, a in self._exec.aux_dict.items()})
+            dts = {n: a.dtype for n, a in self._exec.arg_dict.items()}
+            # aux dtypes too: BatchNorm running stats must price at
+            # their real width in the remat ranking (the PR 8 rule)
+            dts.update({n: a.dtype
+                        for n, a in self._exec.aux_dict.items()})
+            remat_policy, remat_name = _remat.resolve_policy(
+                self._symbol, input_shapes=shapes, input_dtypes=dts)
+            if remat_policy is not None:
+                _profiler.incr_counter("remat_applied")
+        self._remat_name = remat_name
+
+        # ---- microbatch gradient accumulation (fit(grad_accum=N) /
+        # set_grad_accum): the bound batch is split into N equal
+        # microbatches driven through ONE lax.scan inside the step, so
+        # only one microbatch's activations are ever live — batch sizes
+        # that saturate the chip fit in HBM at N× smaller activation
+        # high-water. Accumulated gradients are rescaled so the update
+        # matches the unaccumulated full-batch step exactly (see
+        # _accum_loss_scale for the loss-normalization contract).
+        accum = max(1, int(getattr(self, "_grad_accum", 1) or 1))
+        accum_scale = 1.0
+        if accum > 1:
+            for d in (self._data_shapes or []) + (self._label_shapes or []):
+                if d.shape and d.shape[0] % accum:
+                    raise MXNetError(
+                        "grad_accum=%d does not divide the %r batch "
+                        "dimension %d" % (accum, d.name, d.shape[0]))
+            accum_scale = _accum_loss_scale(self._symbol, accum)
+            _profiler.set_gauge("grad_accum", accum)
 
         def step(params, states, aux, inputs, frozen_vals, key, lr, t):
-            def loss_fn(p):
-                outs, new_aux = fn({**p, **inputs, **frozen_vals}, aux, key,
-                                   True)
-                return outs, new_aux
+            def forward(p_in, aux_in, inp, k):
+                def loss_fn(p):
+                    outs, new_aux = fn({**p, **inp, **frozen_vals},
+                                       aux_in, k, True)
+                    return outs, new_aux
 
-            if remat:
-                # trade forward recompute for activation HBM
-                # (MXNET_EXEC_ENABLE_REMAT). The fused step is one flat
-                # trace with no layer blocks to checkpoint between, so
-                # the save-policy form is used (keep non-batch matmul
-                # outputs, recompute elementwise) — structure-free
-                # jax.checkpoint(loss_fn) measured slightly WORSE
-                # (840 -> 844 MB, tools/perf/doc_evidence.py). Honest
-                # caveat from the same measurement: on dense-attention
-                # transformers neither form cuts peak (the T^2 score
-                # tensors must exist during the backward recompute
-                # anyway); the framework's real memory lever is
-                # custom-vjp residual control (flash attention, LN) —
-                # see docs/architecture/note_memory.md
-                loss_fn = jax.checkpoint(
-                    loss_fn,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+                if remat_policy is not None:
+                    loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
+                (outs, new_aux), vjp = jax.vjp(loss_fn, p_in)
+                cts = [jnp.ones_like(o) for o in outs]
+                grads = vjp((cts, {k2: jnp.zeros_like(v)
+                                   for k2, v in new_aux.items()}))[0]
+                return outs, new_aux, grads
 
-            (outs, new_aux), vjp = jax.vjp(loss_fn, params)
-            cts = [jnp.ones_like(o) for o in outs]
-            grads = vjp((cts, {k: jnp.zeros_like(v)
-                               for k, v in new_aux.items()}))[0]
+            if accum > 1:
+                micro = {n: v.reshape((accum, v.shape[0] // accum)
+                                      + v.shape[1:])
+                         for n, v in inputs.items()}
+
+                def micro_step(carry, xs):
+                    g_acc, aux_c = carry
+                    # per-microbatch RNG: fold the step key once more so
+                    # dropout draws differ across microbatches
+                    outs, new_aux, grads = forward(
+                        params, aux_c, xs["inp"],
+                        jax.random.fold_in(key, xs["i"]))
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                    # aux (BatchNorm stats) advance sequentially, exactly
+                    # like N consecutive small-batch steps
+                    return (g_acc, {**aux_c, **new_aux}), outs
+
+                g0 = jax.tree_util.tree_map(jnp.zeros_like,
+                                            {n: params[n]
+                                             for n in param_names})
+                (grads, new_aux), outs_stacked = jax.lax.scan(
+                    micro_step, (g0, aux),
+                    {"i": jnp.arange(accum, dtype=jnp.int32),
+                     "inp": micro})
+                if accum_scale != 1.0:
+                    grads = {n: g * accum_scale for n, g in grads.items()}
+                outs = [o.reshape((-1,) + o.shape[2:])
+                        for o in outs_stacked]
+            else:
+                outs, new_aux, grads = forward(params, aux, inputs, key)
             new_params, new_states = {}, {}
             for n in param_names:
                 w, s = optimizer.raw_update(
@@ -779,10 +884,23 @@ class Module(BaseModule):
                 lr = self._optimizer.lr_scheduler(t)
             else:
                 lr = self._optimizer.lr
+            call_args = (params, states, aux, inputs, frozen_vals, key,
+                         jnp.asarray(lr, jnp.float32),
+                         jnp.asarray(t, jnp.int32))
             with _obs_compiles.scope("fused_step", self._obs_sig):
-                outs, new_params, new_states, new_aux = self._fused_jit(
-                    params, states, aux, inputs, frozen_vals, key,
-                    jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.int32))
+                if self._fused_call is not None:
+                    # AOT path: a deserialized (or explicitly compiled)
+                    # executable — no jit dispatch, no trace, no compile
+                    outs, new_params, new_states, new_aux = \
+                        self._fused_call(*call_args)
+                elif self._fused_aot_key is not None:
+                    outs, new_params, new_states, new_aux = \
+                        self._fused_aot_first(call_args)
+                else:
+                    outs, new_params, new_states, new_aux = \
+                        self._fused_jit(*call_args)
+            if accum > 1:
+                _profiler.incr_counter("accum_steps", accum)
             n = self._obs_steps + 1
             self._obs_steps = n
             if n == _obs_mfu.OBS_WARMUP_STEPS:
@@ -831,6 +949,49 @@ class Module(BaseModule):
         if getattr(self, "_fused_states", None) is None or \
                 set(self._fused_states) != set(param_names):
             self._fused_states = make_states()
+
+        # ---- AOT warm start (MXNET_TPU_COMPILE_CACHE): key the fused
+        # step's executable on everything its trace bakes in, so a
+        # restarted process deserializes instead of compiling. Fenced to
+        # single-device programs (aot.py: deserialized multi-device
+        # executables mis-execute on this jax version).
+        self._fused_call = None
+        self._fused_aot_key = None
+        if _config.get("MXNET_TPU_COMPILE_CACHE"):
+            from .. import aot as _aot
+            if self._mesh is not None:
+                _profiler.incr_counter("aot_skip_multidevice")
+            elif _aot.supported():
+                try:
+                    from .. import amp as _amp
+                    opt = self._optimizer
+                    sig_parts = (
+                        "fused_step", self._symbol.tojson(),
+                        sorted((n, tuple(a.shape), str(a.dtype))
+                               for n, a in self._exec.arg_dict.items()),
+                        sorted((n, tuple(a.shape), str(a.dtype))
+                               for n, a in self._exec.aux_dict.items()),
+                        tuple(param_names), tuple(frozen),
+                        sorted(self._grad_req.items()),
+                        opt._fused_static_key(),
+                        # statics the module step bakes (FusedUpdater
+                        # passes these dynamically; this trace does not)
+                        opt.wd, opt.rescale_grad, opt.clip_gradient,
+                        sorted(opt.lr_mult.items()),
+                        sorted(opt.wd_mult.items()),
+                        sorted(opt.idx2name.items()),
+                        accum, accum_scale, remat_name,
+                        self._exec._scan_plan.n_layers
+                        if self._exec._scan_plan is not None else 0,
+                        (_amp.active(),
+                         str(_amp.compute_dtype()) if _amp.active()
+                         else ""),
+                    )
+                    self._fused_aot_key = _aot.digest(sig_parts)
+                except Exception:                           # noqa: BLE001
+                    # unkeyable configuration (unhashable optimizer
+                    # statics): no warm start, plain jit dispatch
+                    self._fused_aot_key = None
         if self._mesh is not None:
             # pin updated params to their declared shardings — otherwise
             # GSPMD may pick a different output layout after the first
@@ -850,6 +1011,72 @@ class Module(BaseModule):
         else:
             self._fused_jit = jax.jit(step, donate_argnums=(0, 1, 2))
         self._fused = run
+
+    def _fused_aot_first(self, call_args):
+        """First fused dispatch under MXNET_TPU_COMPILE_CACHE: load the
+        serialized executable for this signature, or AOT-compile
+        (``jit.lower().compile()``) and serialize it for the next
+        process. Either way subsequent steps call a concrete executable
+        — zero jit dispatch overhead, zero recompiles by construction."""
+        from .. import aot as _aot
+        name, key = "fused_step", self._fused_aot_key
+        runner = _aot.load(name, key)
+        if runner is not None:
+            # first call through a deserialized executable runs on
+            # COPIES of the donated trees: if the entry is unusable the
+            # live buffers stay valid for the fresh-compile fallback.
+            # The tiny per-shape copy jits get their own compile scope —
+            # they must not show up as "the fused step compiled" in the
+            # warm-start accounting (the CI gate asserts zero there)
+            with _obs_compiles.scope("aot_first_copy"):
+                safe = jax.tree_util.tree_map(jnp.copy, call_args[:3])
+            try:
+                out = runner(*safe, *call_args[3:])
+            except Exception as exc:                        # noqa: BLE001
+                _profiler.incr_counter("aot_error")
+                self.logger.warning(
+                    "aot: cached fused-step executable failed (%s); "
+                    "recompiling", exc)
+                runner = None
+            else:
+                self._fused_call = runner
+                self._fused_aot_key = None
+                return out
+        try:
+            # compile fresh (bypassing jax's persistent cache): a
+            # cache-loaded executable cannot be re-serialized
+            with _aot.bypass_persistent_cache():
+                compiled = self._fused_jit.lower(*call_args).compile()
+        except Exception:                                   # noqa: BLE001
+            # lowering path failed (never expected); keep plain dispatch
+            self._fused_aot_key = None
+            return self._fused_jit(*call_args)
+        _aot.store(name, key, compiled)
+        self._fused_call = compiled
+        self._fused_aot_key = None
+        return compiled(*call_args)
+
+    def _check_accum_needs_fused(self) -> None:
+        if getattr(self, "_grad_accum", 1) > 1:
+            raise MXNetError(
+                "grad_accum > 1 requires the fused train step; this "
+                "binding falls back to eager update (kvstore/custom "
+                "updater) which cannot microbatch")
+
+    def set_grad_accum(self, n: int) -> None:
+        """Microbatch gradient accumulation: the fused step splits every
+        bound batch into ``n`` equal microbatches run through one
+        ``lax.scan`` with gradient carry, so activation memory scales
+        with the microbatch while the optimizer sees the full-batch
+        gradient (``fit(grad_accum=n)`` routes here). ``n=1`` restores
+        the flat step."""
+        n = int(n)
+        if n < 1:
+            raise MXNetError("grad_accum must be >= 1, got %d" % n)
+        if n != getattr(self, "_grad_accum", 1):
+            self._grad_accum = n
+            if self.optimizer_initialized:
+                self._build_fused_step()
 
     def _fit_step(self, data_batch):
         """One fused train step; fit() uses this when available."""
