@@ -160,9 +160,17 @@ def main():
     parser.add_argument("--num-examples", type=int, default=400)
     parser.add_argument("--min-iou", type=float, default=0.4,
                         help="fail below this mean IoU (<=0 disables)")
+    parser.add_argument("--seed", type=int, default=42,
+                        help="seeds the mx.random chain the initializer "
+                             "draws from (deterministic convergence gate)")
     args = parser.parse_args()
 
     import mxnet_tpu as mx
+    # deterministic init + shuffle: the unseeded global np.random made
+    # this convergence gate flaky (CHANGES PR 4/10); the initializer now
+    # draws from the seeded mx.random key chain
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
     x, labels = synth_detection(args.num_examples, seed=5)
     train = mx.io.NDArrayIter({"data": x}, {"label": labels},
                               args.batch_size, shuffle=True)
@@ -172,7 +180,8 @@ def main():
                         data_names=("data",), label_names=("label",))
     mod.bind(data_shapes=train.provide_data,
              label_shapes=train.provide_label)
-    mod.init_params(mx.init.Xavier(magnitude=2))
+    mod.init_params(mx.init.Xavier(magnitude=2).set_rng(
+        mx.random.derive_numpy_rng("train_ssd")))
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": args.lr,
                                          "momentum": 0.9, "wd": 1e-4})

@@ -69,9 +69,11 @@ def __getattr__(name):
     # mx.analysis resolves lazily (PEP 562): the analyzer must never load
     # unless used — the MXNET_TPU_ANALYZE=off bind path is asserted to be
     # import-free (tests/test_analysis.py::test_analyze_off_is_zero_cost).
+    # elastic/faults ride the same hook (the supervisor is subprocess
+    # tooling, not a training-path dependency).
     # importlib, NOT `from . import analysis`: the fromlist form re-enters
     # this __getattr__ via importlib._handle_fromlist -> infinite recursion
-    if name in ("analysis", "checkpoint"):
+    if name in ("analysis", "checkpoint", "elastic", "faults"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

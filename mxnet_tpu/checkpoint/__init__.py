@@ -18,7 +18,14 @@ do, this subsystem does:
   accumulators — so ``Module.fit(resume_from=dir)`` reproduces an
   uninterrupted run bit-identically;
 * **bounded**: keep-last-N / keep-every-K retention GC that can never
-  delete the only valid checkpoint.
+  delete the only valid checkpoint;
+* **elastic**: ``read_checkpoint(..., mesh=, layout=)`` re-lays every
+  array out onto a DIFFERENT mesh/spec than it was saved from (per-shard
+  index windows + checksums in the manifest; ``reshard_tensors``), the
+  writer retries transient IO errors with bounded backoff
+  (``ckpt_write_retry``), and every recovery path is drivable under
+  deterministic fault injection (``mxnet_tpu.faults``,
+  docs/architecture/elastic.md).
 
 Typical use::
 
@@ -32,7 +39,8 @@ from .atomic import atomic_open, fsync_dir, replace_and_sync
 from .format import (ARRAYS_NAME, MANIFEST_NAME, CheckpointCorrupt,
                      CheckpointError, CheckpointNotFound,
                      collect_garbage, list_checkpoints, load_latest,
-                     probe_valid, read_checkpoint, write_checkpoint)
+                     probe_valid, read_checkpoint, reshard_tensors,
+                     resolve_layout_spec, write_checkpoint)
 from .manager import (Checkpoint, CheckpointConfig, CheckpointManager,
                       restore_global_rng, restore_latest)
 
@@ -41,6 +49,7 @@ __all__ = [
     "CheckpointError", "CheckpointCorrupt", "CheckpointNotFound",
     "restore_latest", "restore_global_rng",
     "write_checkpoint", "read_checkpoint", "load_latest",
+    "reshard_tensors", "resolve_layout_spec",
     "list_checkpoints", "probe_valid", "collect_garbage",
     "atomic_open", "fsync_dir", "replace_and_sync",
     "ARRAYS_NAME", "MANIFEST_NAME",

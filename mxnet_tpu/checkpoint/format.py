@@ -37,13 +37,13 @@ import logging
 import os
 import re
 import shutil
-import signal
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..base import MXNetError
+from .. import faults as _faults
 from . import atomic as _atomic
 
 __all__ = [
@@ -51,7 +51,7 @@ __all__ = [
     "FORMAT_VERSION", "MANIFEST_NAME", "ARRAYS_NAME",
     "checkpoint_dir_name", "list_checkpoints", "probe_valid",
     "write_checkpoint", "read_manifest", "read_checkpoint", "load_latest",
-    "collect_garbage",
+    "collect_garbage", "resolve_layout_spec", "reshard_tensors",
 ]
 
 FORMAT_VERSION = "mxnet_tpu.checkpoint/1"
@@ -82,28 +82,15 @@ class CheckpointNotFound(CheckpointError):
     """No loadable checkpoint exists under the base directory."""
 
 
-# Fault-injection hook for the crash-safety suite: when this env var names
-# a phase, the writer SIGKILLs its own process at that exact point —
-# the honest `kill -9 mid-write` with deterministic timing. A suffix
-# ``@N`` arms the crash on the N-th time the writer reaches that point
-# ("let two checkpoints land, die during the third"). Never set outside
-# tests.
-_CRASH_ENV = "MXNET_TPU_CKPT_TEST_CRASH"
-_crash_hits: Dict[str, int] = {}
-
-
+# Writer injection points for the crash-safety suite, now served by the
+# general fault harness (mxnet_tpu.faults): ``MXNET_TPU_FAULTS=
+# ckpt.<point>@<n>[:kind]`` fires at the n-th arrival; the PR 5 env
+# ``MXNET_TPU_CKPT_TEST_CRASH=<point>@<n>`` still works (faults.py
+# parses it as ``ckpt.<point>@<n>:sigkill`` — the honest `kill -9
+# mid-write` with deterministic timing). Never set outside tests.
 def _maybe_crash(point: str) -> None:
-    spec = os.environ.get(_CRASH_ENV)
-    if not spec:
-        return
-    want, _, nth = spec.partition("@")
-    if want != point:
-        return
-    if nth:
-        _crash_hits[point] = _crash_hits.get(point, 0) + 1
-        if _crash_hits[point] < int(nth):
-            return
-    os.kill(os.getpid(), signal.SIGKILL)
+    if _faults.armed_or_env():
+        _faults.fire("ckpt." + point, default_kind="sigkill")
 
 
 def _crc32(arr: np.ndarray) -> int:
@@ -148,8 +135,8 @@ def _decompose(name: str, val: Any, arrays: Dict[str, np.ndarray]
         return {"kind": "full", "key": name}
     sharding = val.sharding
     try:
-        mesh = {str(a): int(s) for a, s in
-                zip(sharding.mesh.axis_names, sharding.mesh.devices.shape)}
+        from ..parallel.mesh import axis_sizes
+        mesh = axis_sizes(sharding.mesh)
         spec = str(tuple(sharding.spec))
     except AttributeError:                   # non-NamedSharding
         mesh, spec = {}, repr(sharding)
@@ -171,22 +158,93 @@ def _decompose(name: str, val: Any, arrays: Dict[str, np.ndarray]
 
 def _compose(name: str, entry: Dict[str, Any],
              raw: Dict[str, np.ndarray]) -> np.ndarray:
-    """Inverse of :func:`_decompose` — reassemble a full host array."""
+    """Inverse of :func:`_decompose` — reassemble a full host array.
+
+    Coverage is tracked with a boolean mask, not a naive element count:
+    index windows written by exotic layouts may OVERLAP (a spec that
+    replicates over one axis while sharding another records a window per
+    distinct slice, and two checkpoint generations merged by hand can
+    overlap partially) — overlapping writes dedup by last-writer-wins
+    (each source shard is independently crc-verified upstream, so
+    overlapping regions hold identical bytes), while any UNCOVERED
+    element is still a hard :class:`CheckpointCorrupt`."""
     if entry["kind"] == "full":
         return raw[entry["key"]]
     shape = tuple(entry["shape"])
     out = np.empty(shape, dtype=np.dtype(entry["dtype"]))
-    filled = 0
+    covered = np.zeros(shape, dtype=bool)
     for sh in entry["shards"]:
         window = tuple(slice(*w) if w else slice(None)
                        for w in sh["index"])
         piece = raw[sh["key"]]
-        out[window] = piece
-        filled += piece.size
-    if filled < out.size:
+        try:
+            # exact-fit only: broadcasting a smaller (crc-valid) shard
+            # into a bit-rotted window would mark it covered while
+            # silently replicating rows
+            if out[window].shape != piece.shape:
+                raise ValueError(
+                    "shard shape %s does not exactly fill window shape %s"
+                    % (piece.shape, out[window].shape))
+            out[window] = piece
+        except (ValueError, IndexError) as exc:
+            raise CheckpointCorrupt(
+                "sharded tensor %r: shard %r does not fit window %s: %s"
+                % (name, sh["key"], sh["index"], exc)) from None
+        covered[window] = True
+    if not covered.all():
+        missing = int(out.size - np.count_nonzero(covered))
         raise CheckpointCorrupt(
             "sharded tensor %r: shards cover %d of %d elements"
-            % (name, filled, out.size))
+            % (name, out.size - missing, out.size))
+    return out
+
+
+# ----------------------------------------------------------- resharding
+
+# re-exported from parallel.mesh: ONE canonical name->spec resolution
+# shared with Module(param_shardings=...) bind-time placement, so a
+# checkpoint restored by layout can never resolve differently than the
+# bind that will consume it
+from ..parallel.mesh import Layout, resolve_layout_spec  # noqa: E402
+
+
+def reshard_tensors(tensors: Dict[str, np.ndarray], mesh, layout: Layout
+                    = None, manifest: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """Lay reassembled host tensors out onto a (possibly different) mesh.
+
+    This is the elastic half of the checkpoint contract (ROADMAP item 4):
+    the manifest records each sharded array's index windows + source
+    mesh/spec, :func:`_compose` already reassembles the full host value,
+    and this function re-lays it out onto ANY target mesh — N-chip save
+    to M-chip restore, down to 1 device and back up, dp/tp/fsdp-style or
+    replicated specs. Divisibility is validated per array with the
+    offending name in the error (``parallel.mesh.validate_spec``);
+    arrays whose recorded source mesh differs from the target count
+    ``ckpt_reshard`` (the manifest, when given, provides the recorded
+    source meshes)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from .. import profiler as _profiler
+    from ..parallel.mesh import axis_sizes, validate_spec
+    table = (manifest or {}).get("tensors", {})
+    target = axis_sizes(mesh)
+    out: Dict[str, Any] = {}
+    resharded = 0
+    for name, arr in tensors.items():
+        spec = resolve_layout_spec(layout, name)
+        try:
+            validate_spec(mesh, spec, np.shape(arr), name=name)
+        except ValueError as exc:
+            raise CheckpointError("reshard-on-load: %s" % exc) from None
+        sharding = NamedSharding(mesh, spec if spec is not None
+                                 else PartitionSpec())
+        out[name] = jax.device_put(arr, sharding)
+        src_mesh = table.get(name, {}).get("mesh")
+        if src_mesh is not None and src_mesh != target:
+            resharded += 1
+    if resharded:
+        _profiler.incr_counter("ckpt_reshard", resharded)
     return out
 
 
@@ -224,6 +282,12 @@ def write_checkpoint(base: str, step: int, tensors: Dict[str, Any],
         tensor_table = {name: _decompose(name, val, arrays)
                         for name, val in tensors.items()}
         arrays_path = os.path.join(tmp, ARRAYS_NAME)
+        if _faults.armed_or_env():
+            # transient-IO drill point (EIO/ENOSPC/EINTR): fires before
+            # any byte lands, so the cleanup path removes only the tmp
+            # dir and the manager's bounded retry re-enters cleanly
+            _faults.fire("ckpt.arrays_write", path=arrays_path,
+                         default_kind="eio")
         with open(arrays_path, "wb") as f:
             np.savez(f, **arrays)
             f.flush()
@@ -280,6 +344,12 @@ def list_checkpoints(base: str) -> List[Tuple[int, str]]:
 
 
 def read_manifest(path: str) -> Dict[str, Any]:
+    if _faults.armed_or_env():
+        # bit-rot/truncation drills: corrupt the manifest ON DISK before
+        # the read, so detection + fallback run against a real torn file
+        _faults.fire("ckpt.read_manifest",
+                     path=os.path.join(path, MANIFEST_NAME),
+                     default_kind="bitflip")
     try:
         with open(os.path.join(path, MANIFEST_NAME)) as f:
             manifest = json.load(f)
@@ -309,14 +379,24 @@ def probe_valid(path: str) -> bool:
         return False
 
 
-def read_checkpoint(path: str, verify: bool = True
-                    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+def read_checkpoint(path: str, verify: bool = True, mesh=None,
+                    layout: Layout = None
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Load one checkpoint directory -> (tensors, manifest), verifying
     every array against its manifest record. Raises
     :class:`CheckpointCorrupt` on ANY mismatch (wrong set of arrays,
-    shape/dtype drift, checksum failure, unreadable container)."""
+    shape/dtype drift, checksum failure, unreadable container).
+
+    With ``mesh=`` (and an optional ``layout=`` of name -> PartitionSpec,
+    exact or regex), every tensor is additionally RE-LAID-OUT onto that
+    mesh after reassembly (:func:`reshard_tensors`) — the checkpoint may
+    have been saved from a completely different mesh shape/spec; each
+    source shard is checksum-verified before it contributes."""
     manifest = read_manifest(path)
     arrays_path = os.path.join(path, ARRAYS_NAME)
+    if _faults.armed_or_env():
+        _faults.fire("ckpt.read_arrays", path=arrays_path,
+                     default_kind="bitflip")
     raw: Dict[str, np.ndarray] = {}
     try:
         with np.load(arrays_path, allow_pickle=False) as zf:
@@ -357,21 +437,26 @@ def read_checkpoint(path: str, verify: bool = True
         # fallback chain breaks
         raise CheckpointCorrupt("%s: corrupt tensor table: %r"
                                 % (path, exc)) from None
+    if mesh is not None:
+        tensors = reshard_tensors(tensors, mesh, layout, manifest=manifest)
     return tensors, manifest
 
 
-def load_latest(base: str, verify: bool = True
-                ) -> Tuple[str, Dict[str, np.ndarray], Dict[str, Any]]:
+def load_latest(base: str, verify: bool = True, mesh=None,
+                layout: Layout = None
+                ) -> Tuple[str, Dict[str, Any], Dict[str, Any]]:
     """Newest checkpoint that VERIFIES -> (path, tensors, manifest).
 
     Corrupt/torn candidates are skipped with a warning (counted
     ``ckpt_load_fallback``); raises :class:`CheckpointNotFound` when
-    nothing under ``base`` loads."""
+    nothing under ``base`` loads. ``mesh=``/``layout=`` reshard-on-load
+    as in :func:`read_checkpoint`."""
     from .. import profiler as _profiler
     entries = list_checkpoints(base)
     for step, path in reversed(entries):
         try:
-            tensors, manifest = read_checkpoint(path, verify=verify)
+            tensors, manifest = read_checkpoint(path, verify=verify,
+                                                mesh=mesh, layout=layout)
             _profiler.incr_counter("ckpt_load_ok")
             return path, tensors, manifest
         except CheckpointCorrupt as exc:

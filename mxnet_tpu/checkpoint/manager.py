@@ -25,6 +25,7 @@ one (tests/test_checkpoint.py parity suite).
 """
 from __future__ import annotations
 
+import errno as _errno
 import logging
 import os
 import queue as _queue_mod
@@ -135,6 +136,13 @@ class CheckpointConfig(object):
     queue_depth : int
         Bounded writer queue (each queued snapshot pins one generation of
         parameters until written; depth bounds that memory).
+    write_retries : int, optional
+        Bounded retry of a failed write on TRANSIENT IO errors
+        (EIO/ENOSPC/EINTR) with exponential backoff before the failure
+        is recorded/re-raised (default: the ``MXNET_TPU_CKPT_WRITE_RETRIES``
+        knob). Each retry counts ``ckpt_write_retry``.
+    retry_backoff : float
+        Base seconds of the retry backoff (doubles per attempt).
     """
 
     def __init__(self, directory: str, period_epochs: int = 1,
@@ -145,7 +153,9 @@ class CheckpointConfig(object):
                  save_on_sigterm: bool = True,
                  verify_on_load: bool = True,
                  store_symbol: bool = True,
-                 queue_depth: int = 2):
+                 queue_depth: int = 2,
+                 write_retries: Optional[int] = None,
+                 retry_backoff: float = 0.25):
         self.directory = str(directory)
         self.period_epochs = int(period_epochs)
         self.every_n_batches = None if every_n_batches is None \
@@ -157,6 +167,8 @@ class CheckpointConfig(object):
         self.verify_on_load = bool(verify_on_load)
         self.store_symbol = bool(store_symbol)
         self.queue_depth = max(1, int(queue_depth))
+        self.write_retries = write_retries
+        self.retry_backoff = max(0.0, float(retry_backoff))
 
     @classmethod
     def coerce(cls, obj) -> "CheckpointConfig":
@@ -179,6 +191,12 @@ class CheckpointConfig(object):
             return bool(self.async_save)
         from .. import config as _config
         return bool(_config.get("MXNET_TPU_CKPT_ASYNC"))
+
+    def resolved_write_retries(self) -> int:
+        if self.write_retries is not None:
+            return max(0, int(self.write_retries))
+        from .. import config as _config
+        return max(0, int(_config.get("MXNET_TPU_CKPT_WRITE_RETRIES")))
 
 
 # ---------------------------------------------------------- the payload
@@ -467,12 +485,38 @@ class CheckpointManager(object):
                 _profiler.set_gauge("ckpt_queue_depth", q.qsize())
                 q.task_done()
 
+    # IO errors a retry can plausibly outlive: a flaky block device
+    # (EIO), a quota/GC race on shared storage (ENOSPC — retention GC
+    # runs between attempts and may have freed space), an interrupted
+    # syscall (EINTR). Anything else re-raises immediately.
+    _TRANSIENT_ERRNO = frozenset(
+        (_errno.EIO, _errno.ENOSPC, _errno.EINTR))
+
     def _write_one(self, step, tensors, meta) -> None:
         from .. import profiler as _profiler
         t0 = time.perf_counter()
+        retries = self.config.resolved_write_retries()
         with _profiler.span("ckpt_write", "ckpt"):
-            path = _format.write_checkpoint(self.config.directory, step,
-                                            tensors, meta)
+            for attempt in range(retries + 1):
+                try:
+                    path = _format.write_checkpoint(
+                        self.config.directory, step, tensors, meta)
+                    break
+                except OSError as exc:
+                    # write_checkpoint cleans its .tmp-* on the way out,
+                    # so a retry starts from a blank slate
+                    if exc.errno not in self._TRANSIENT_ERRNO \
+                            or attempt >= retries:
+                        raise
+                    _profiler.incr_counter("ckpt_write_retry")
+                    delay = self.config.retry_backoff * (2 ** attempt)
+                    log.warning(
+                        "checkpoint write hit transient %s (attempt "
+                        "%d/%d); retrying in %.2fs",
+                        _errno.errorcode.get(exc.errno, exc.errno),
+                        attempt + 1, retries + 1, delay)
+                    if delay:
+                        time.sleep(delay)
         try:
             nbytes = os.path.getsize(
                 os.path.join(path, _format.ARRAYS_NAME))

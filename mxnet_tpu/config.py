@@ -165,6 +165,31 @@ register("MXNET_TPU_CKPT_KEEP", int, 5,
          "mx.checkpoint: retention — keep the newest N valid checkpoints "
          "after each save (keep-every-K survivors and the newest valid "
          "checkpoint are always kept); 0 = keep everything")
+register("MXNET_TPU_CKPT_WRITE_RETRIES", int, 3,
+         "mx.checkpoint: bounded retry of a failed checkpoint write on "
+         "TRANSIENT IO errors (EIO/ENOSPC/EINTR) with exponential "
+         "backoff before the failure is recorded and re-raised at "
+         "close; each retry counts ckpt_write_retry. 0 = fail on the "
+         "first error")
+register("MXNET_TPU_FAULTS", str, "",
+         "deterministic fault injection: comma list of "
+         "<site>@<nth>[:kind] specs fired at named injection points "
+         "(ckpt.arrays_write, ckpt.before_rename, ckpt.read_manifest, "
+         "fit.batch, serve.submit, ...; kinds eio/enospc/eintr/raise/"
+         "sigterm/sigkill/bitflip/truncate — see "
+         "docs/architecture/elastic.md). Parsed once at import by "
+         "mxnet_tpu.faults; zero-cost when empty. NEVER set in "
+         "production")
+register("MXNET_TPU_ELASTIC_MAX_RESTARTS", int, 10,
+         "mx.elastic supervisor: restarts allowed before giving up and "
+         "returning the child's exit status (exit 143 and crashes both "
+         "count as preemptions)")
+register("MXNET_TPU_ELASTIC_BACKOFF", float, 1.0,
+         "mx.elastic supervisor: base seconds of the exponential "
+         "restart backoff (doubles per consecutive restart, plus up to "
+         "25 percent jitter)")
+register("MXNET_TPU_ELASTIC_BACKOFF_MAX", float, 60.0,
+         "mx.elastic supervisor: backoff ceiling in seconds")
 register("MXNET_TPU_OBS", _parse_bool, False,
          "mx.obs: record structured spans (per-thread lanes + chrome-trace "
          "flow events linking one batch across prefetch/train/metric/"

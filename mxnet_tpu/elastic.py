@@ -1,0 +1,343 @@
+"""Preemption-aware elastic training supervisor (ROADMAP item 4).
+
+``fit`` already survives a preemption NOTICE: SIGTERM lands a final
+synchronous checkpoint and exits 143 (PR 5). This module supplies the
+missing half — the thing that *re-enters* training after the preemption:
+
+* run the training program in a CHILD process (a supervisor that shares
+  the training process dies with it — only a process boundary survives
+  ``kill -9``);
+* treat exit 143 (clean preemption) and any crash (signal death,
+  non-zero exit) as a restartable event, bounded by
+  ``MXNET_TPU_ELASTIC_MAX_RESTARTS`` with exponential backoff + jitter;
+* re-probe the visible device set between attempts and re-launch the
+  child at the NEW world size (on preemptible capacity the replacement
+  slice is routinely smaller or larger than the one that died);
+* the child resumes from the newest valid checkpoint
+  (``resume_dir(base)``) — reshard-on-load re-lays every array out onto
+  whatever mesh the new world size builds, so an 8-chip checkpoint
+  restores onto 4 chips, 2, or 1 (``docs/architecture/elastic.md``).
+
+The supervisor itself is deliberately framework-light: this module
+touches only stdlib + the config/profiler modules, and the supervisor
+process must never INITIALIZE a jax backend (``python -m`` necessarily
+imports the package, which imports the jax library — but a backend pins
+its device view for the life of the process, so every device query runs
+in a throwaway subprocess instead: :func:`probe_world`). A regression
+test runs the supervisor under an unresolvable ``JAX_PLATFORMS`` so any
+in-process backend initialization fails loudly.
+
+CLI::
+
+    python -m mxnet_tpu.elastic [--max-restarts N] [--backoff S]
+        [--world-schedule 8,4,2] -- python train.py --my-args
+
+Environment exported to every attempt:
+
+* ``MXNET_TPU_ELASTIC_ATTEMPT`` — 0-based attempt index (the training
+  script can key per-attempt behavior on it; the fault drills do);
+* ``MXNET_TPU_ELASTIC_RESUMED=1`` — on every attempt after the first;
+* with a world schedule (the virtual-mesh test rig), ``XLA_FLAGS`` is
+  rewritten with ``--xla_force_host_platform_device_count=<n>`` so the
+  child binds at the scheduled world size.
+
+Counters: ``elastic_restart`` (every re-entry), ``elastic_preempt``
+(exit-143 children), ``elastic_crash`` (signal/non-zero children),
+``elastic_reshard`` (re-entries whose world size changed) and the
+``elastic_world`` gauge.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import random as _pyrandom
+import re
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["Supervisor", "supervise", "resume_dir", "probe_world", "main"]
+
+log = logging.getLogger(__name__)
+
+_DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def resume_dir(base: str) -> Optional[str]:
+    """``base`` if it holds at least one VALID checkpoint, else None —
+    the one-liner a training script needs to pass
+    ``fit(resume_from=...)`` only when there is something to resume
+    (attempt 0 of an elastic run starts from scratch)."""
+    from .checkpoint import format as _format
+    for _step, path in reversed(_format.list_checkpoints(str(base))):
+        if _format.probe_valid(path):
+            return str(base)
+    return None
+
+
+def probe_world(env: Optional[dict] = None,
+                timeout: float = 120.0) -> Optional[int]:
+    """Re-probe the visible device set in a THROWAWAY subprocess (jax
+    caches its backend for the life of a process — the supervisor must
+    never bind one). Returns the device count, or None when the probe
+    fails (backend wedged mid-preemption: the caller backs off and
+    retries on the next attempt)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout,
+            env=env if env is not None else os.environ.copy())
+        if out.returncode == 0:
+            return int(out.stdout.strip().splitlines()[-1])
+    except (subprocess.SubprocessError, ValueError, IndexError, OSError):
+        pass
+    return None
+
+
+def _with_device_count(flags: str, n: int) -> str:
+    """XLA_FLAGS with the host-platform device count pinned to ``n``."""
+    kept = [f for f in flags.split()
+            if not f.startswith(_DEVCOUNT_FLAG + "=")]
+    kept.append("%s=%d" % (_DEVCOUNT_FLAG, n))
+    return " ".join(kept)
+
+
+class Supervisor(object):
+    """Run one training command elastically; see module docstring.
+
+    Parameters
+    ----------
+    argv : list of str
+        The child command. A leading ``*.py`` token is run with the
+        current interpreter.
+    max_restarts, backoff, backoff_max : optional
+        Defaults from the ``MXNET_TPU_ELASTIC_*`` knobs.
+    world_schedule : list of int, optional
+        Virtual-mesh test rig: attempt ``i`` runs at
+        ``schedule[min(i, len-1)]`` host devices (via ``XLA_FLAGS``).
+        Without a schedule the device set is re-probed from the real
+        backend between attempts (``probe_world``).
+    jitter_seed : optional
+        Seeds the backoff jitter for deterministic tests.
+    """
+
+    def __init__(self, argv: Sequence[str],
+                 max_restarts: Optional[int] = None,
+                 backoff: Optional[float] = None,
+                 backoff_max: Optional[float] = None,
+                 world_schedule: Optional[Sequence[int]] = None,
+                 env: Optional[dict] = None,
+                 jitter_seed: Optional[int] = None,
+                 on_attempt: Optional[Callable[[int, dict], None]] = None):
+        from . import config as _config
+        argv = list(argv)
+        if argv and argv[0].endswith(".py"):
+            argv.insert(0, sys.executable)
+        if not argv:
+            raise ValueError("elastic supervisor needs a child command")
+        self.argv = argv
+        self.max_restarts = int(
+            _config.get("MXNET_TPU_ELASTIC_MAX_RESTARTS")
+            if max_restarts is None else max_restarts)
+        self.backoff = float(_config.get("MXNET_TPU_ELASTIC_BACKOFF")
+                             if backoff is None else backoff)
+        self.backoff_max = float(
+            _config.get("MXNET_TPU_ELASTIC_BACKOFF_MAX")
+            if backoff_max is None else backoff_max)
+        self.world_schedule = [int(w) for w in world_schedule] \
+            if world_schedule else None
+        self.env = dict(env) if env is not None else None
+        self._rng = _pyrandom.Random(jitter_seed)
+        self._on_attempt = on_attempt
+        self.restarts = 0
+        self.reshards = 0
+        self._child: Optional[subprocess.Popen] = None
+        self._terminated = False
+
+    # ------------------------------------------------------------ signals
+    def _install_forwarder(self):
+        """Forward a SIGTERM aimed at the supervisor to the child (the
+        scheduler preempts the whole allocation — the child must get its
+        preemption notice) and stop restarting. Flag-set + os.kill only:
+        anything allocation- or lock-heavy is unsafe in a handler."""
+        if not hasattr(signal, "SIGTERM"):
+            return None
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _handler(_signum, _frame):
+                self._terminated = True
+                child = self._child
+                if child is not None:
+                    try:
+                        os.kill(child.pid, signal.SIGTERM)
+                    except OSError:
+                        pass        # already gone
+
+
+
+            signal.signal(signal.SIGTERM, _handler)
+        except (ValueError, OSError):
+            return None         # not the main thread
+
+        def _restore():
+            try:
+                signal.signal(signal.SIGTERM, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+
+        return _restore
+
+    def _backoff_sleep(self, delay: float) -> None:
+        """Backoff that a between-attempts SIGTERM can cut short: PEP 475
+        resumes one long ``time.sleep`` after the flag-only handler
+        returns, so sleep in small slices and re-check the flag (an
+        Event would be cleaner but ``Event.set`` takes a lock — the
+        signal-unsafe hazard class the repo lint rejects)."""
+        deadline = time.monotonic() + max(0.0, delay)
+        while not self._terminated:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(0.25, left))
+
+    # ------------------------------------------------------------- world
+    def _world_for_attempt(self, attempt: int) -> Optional[int]:
+        if self.world_schedule:
+            i = min(attempt, len(self.world_schedule) - 1)
+            return self.world_schedule[i]
+        return None
+
+    def _env_for_attempt(self, attempt: int, world: Optional[int]) -> dict:
+        env = dict(self.env if self.env is not None else os.environ)
+        env["MXNET_TPU_ELASTIC_ATTEMPT"] = str(attempt)
+        if attempt > 0:
+            env["MXNET_TPU_ELASTIC_RESUMED"] = "1"
+        if world is not None:
+            env["XLA_FLAGS"] = _with_device_count(
+                env.get("XLA_FLAGS", ""), world)
+        return env
+
+    # -------------------------------------------------------------- run
+    def run(self) -> int:
+        from . import profiler as _profiler
+        restore_sig = self._install_forwarder()
+        attempt = 0
+        prev_world: Optional[int] = None
+        try:
+            while True:
+                if self._terminated:
+                    # the preemption landed BETWEEN attempts (backoff
+                    # sleep / world probe): do not spawn a fresh child
+                    # just to have the platform hard-kill it
+                    log.warning("elastic: supervisor was SIGTERMed "
+                                "between attempts; not restarting")
+                    return 143
+                world = self._world_for_attempt(attempt)
+                env = self._env_for_attempt(attempt, world)
+                if world is None:
+                    # real backend: ask a throwaway process what is
+                    # actually visible right now (logging + reshard
+                    # accounting; the child binds whatever it sees)
+                    world = probe_world(env)
+                if world is not None:
+                    _profiler.set_gauge("elastic_world", world)
+                if attempt > 0 and world is not None \
+                        and prev_world is not None and world != prev_world:
+                    self.reshards += 1
+                    _profiler.incr_counter("elastic_reshard")
+                    log.warning("elastic: world size %d -> %d; the child "
+                                "will reshard-on-load", prev_world, world)
+                prev_world = world if world is not None else prev_world
+                if self._on_attempt is not None:
+                    self._on_attempt(attempt, env)
+                log.info("elastic attempt %d (world=%s): %s",
+                         attempt, world, " ".join(self.argv))
+                self._child = subprocess.Popen(self.argv, env=env)
+                rc = self._child.wait()
+                self._child = None
+                if rc == 0:
+                    return 0
+                if self._terminated:
+                    # the preemption was aimed at US — do not restart,
+                    # propagate the conventional status
+                    log.warning("elastic: supervisor was SIGTERMed; "
+                                "child exited %d; not restarting", rc)
+                    return 143
+                if rc == 143:
+                    _profiler.incr_counter("elastic_preempt")
+                    log.warning("elastic: child preempted (exit 143)")
+                else:
+                    _profiler.incr_counter("elastic_crash")
+                    log.warning("elastic: child died (%s)",
+                                "signal %d" % -rc if rc < 0
+                                else "exit %d" % rc)
+                if self.restarts >= self.max_restarts:
+                    log.error("elastic: restart budget exhausted "
+                              "(%d); giving up with rc=%d",
+                              self.max_restarts, rc)
+                    return rc if rc != 0 else 1
+                self.restarts += 1
+                _profiler.incr_counter("elastic_restart")
+                delay = min(self.backoff_max,
+                            self.backoff * (2 ** (self.restarts - 1)))
+                delay *= 1.0 + 0.25 * self._rng.random()
+                log.info("elastic: restart %d/%d in %.2fs",
+                         self.restarts, self.max_restarts, delay)
+                self._backoff_sleep(delay)
+                attempt += 1
+        finally:
+            if restore_sig is not None:
+                restore_sig()
+
+
+def supervise(argv: Sequence[str], **kwargs) -> int:
+    """One-call form: build a :class:`Supervisor` and run it."""
+    return Supervisor(argv, **kwargs).run()
+
+
+def _parse_schedule(s: str) -> List[int]:
+    parts = [p for p in re.split(r"[,x\s]+", s.strip()) if p]
+    sched = [int(p) for p in parts]
+    if not sched or any(w < 1 for w in sched):
+        raise argparse.ArgumentTypeError(
+            "--world-schedule wants positive device counts, e.g. 8,4,2")
+    return sched
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.elastic",
+        description="preemption-aware elastic training supervisor: runs "
+                    "a training command in a child process, restarts it "
+                    "on preemption (exit 143) or crash at the current "
+                    "device-set size, bounded with backoff")
+    parser.add_argument("--max-restarts", type=int, default=None)
+    parser.add_argument("--backoff", type=float, default=None,
+                        help="base seconds of the exponential backoff")
+    parser.add_argument("--backoff-max", type=float, default=None)
+    parser.add_argument("--world-schedule", type=_parse_schedule,
+                        default=None,
+                        help="test rig: host device count per attempt, "
+                             "e.g. 8,4,2 (last entry repeats)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="child command (prefix with -- to separate)")
+    args = parser.parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no child command given")
+    logging.basicConfig(level=logging.INFO,
+                        format="[elastic] %(message)s")
+    return supervise(command, max_restarts=args.max_restarts,
+                     backoff=args.backoff, backoff_max=args.backoff_max,
+                     world_schedule=args.world_schedule)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

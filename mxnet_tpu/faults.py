@@ -1,0 +1,291 @@
+"""Deterministic fault injection — ``MXNET_TPU_FAULTS=<site>@<nth>[:kind]``.
+
+Robustness code that is never exercised is broken code waiting for its
+first real outage. This module threads *named injection points* through
+the framework's recovery paths so every one of them can be driven
+deterministically, in-process or from a subprocess drill, with zero cost
+when disarmed (one module-attribute bool per site — no parsing, no
+allocation; the CI ``elastic`` job asserts the knobs-off run is
+counter-silent).
+
+Spec grammar (comma-separated list)::
+
+    MXNET_TPU_FAULTS=ckpt.arrays_write@1:eio,ckpt.arrays_write@2:enospc
+    MXNET_TPU_FAULTS=fit.batch@12:sigterm
+    MXNET_TPU_FAULTS=ckpt.read_manifest@1:bitflip
+
+``site`` names an injection point (catalog below), ``nth`` is the
+1-based arrival count at that site in this process ("let two saves land,
+fail the third"), and ``kind`` picks the failure mode (each site has a
+sensible default). The legacy ``MXNET_TPU_CKPT_TEST_CRASH=<point>@<n>``
+hook (PR 5) is parsed as an alias for ``ckpt.<point>@<n>:sigkill``.
+
+Fault sites (the catalog ``docs/architecture/elastic.md`` documents):
+
+===================  ============================  =====================
+site                 where                         default kind
+===================  ============================  =====================
+ckpt.arrays_write    writer, start of arrays.npz   eio
+ckpt.after_arrays    writer, arrays fsynced        sigkill
+ckpt.after_manifest  writer, manifest fsynced      sigkill
+ckpt.before_rename   writer, pre-rename (torn)     sigkill
+ckpt.read_manifest   reader, before manifest open  bitflip
+ckpt.read_arrays     reader, before npz open       bitflip
+fit.batch            fit loop, each batch start    sigterm
+serve.submit         InferenceServer.submit        raise
+===================  ============================  =====================
+
+Failure kinds: ``eio``/``enospc``/``eintr`` raise the matching
+``OSError`` (the writer's bounded-retry path treats these as transient);
+``raise`` raises :class:`FaultInjected`; ``sigterm``/``sigkill`` deliver
+the signal to this process (preemption-notice / hard-kill drills);
+``bitflip`` flips one byte in the middle of the site's file and returns
+(the subsequent read must *detect* the corruption); ``truncate`` cuts
+the site's file in half and returns.
+
+Every fired fault bumps the ``fault_injected`` profiler counter (plus
+``fault_injected.<site>``) *before* acting, so even a SIGKILL drill
+leaves an attributable trace in a parent-readable counter dump.
+"""
+from __future__ import annotations
+
+import errno
+import os
+import signal
+import threading
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+
+__all__ = ["FaultInjected", "ARMED", "fire", "install", "clear",
+           "active_specs", "KINDS", "ENV", "LEGACY_ENV"]
+
+ENV = "MXNET_TPU_FAULTS"
+LEGACY_ENV = "MXNET_TPU_CKPT_TEST_CRASH"
+
+KINDS = ("eio", "enospc", "eintr", "raise", "sigterm", "sigkill",
+         "bitflip", "truncate")
+
+# the shipped injection points (docs/architecture/elastic.md catalog).
+# A spec naming a site outside this set is accepted — new sites must be
+# armable before the catalog ships — but WARNED about: a typo'd site
+# never fires and the drill vacuously passes as "recovered"
+SITES = frozenset((
+    "ckpt.arrays_write", "ckpt.after_arrays", "ckpt.after_manifest",
+    "ckpt.before_rename", "ckpt.read_manifest", "ckpt.read_arrays",
+    "fit.batch", "serve.submit",
+))
+
+_ERRNO = {"eio": errno.EIO, "enospc": errno.ENOSPC, "eintr": errno.EINTR}
+
+
+class FaultInjected(MXNetError):
+    """The error raised by ``kind=raise`` injection sites."""
+
+
+class _Spec(object):
+    __slots__ = ("site", "nth", "kind")
+
+    def __init__(self, site: str, nth: Optional[int], kind: Optional[str]):
+        self.site = site
+        self.nth = nth
+        self.kind = kind
+
+    def __repr__(self):
+        return "%s@%s%s" % (self.site, self.nth if self.nth else "*",
+                            ":" + self.kind if self.kind else "")
+
+
+_lock = threading.Lock()
+_specs: List[_Spec] = []
+_hits: Dict[str, int] = {}
+# clear() is final: armed_or_env() must not resurrect env-derived specs
+# an explicit clear() disarmed (a one-shot @nth fault re-arming with
+# fresh arrival counts would fire a second time)
+_env_disarmed = False
+
+# hot-path guard: call sites check `if faults.ARMED:` before calling
+# fire() — one attribute read when fault injection is off
+ARMED = False
+
+
+def _parse_one(item: str, default_kind: Optional[str] = None) -> _Spec:
+    item = item.strip()
+    if "@" in item:
+        site, _, rest = item.partition("@")
+        nth_s, _, kind = rest.partition(":")
+    else:                       # "<site>:<kind>" fires on EVERY arrival
+        site, _, kind = item.partition(":")
+        nth_s = ""
+    if not site:
+        raise ValueError("%s: empty site in %r" % (ENV, item))
+    kind = kind.strip().lower() or default_kind
+    if kind is not None and kind not in KINDS:
+        raise ValueError("%s: unknown fault kind %r in %r (known: %s)"
+                         % (ENV, kind, item, ", ".join(KINDS)))
+    nth = None
+    if nth_s.strip():
+        nth = int(nth_s)
+        if nth < 1:
+            raise ValueError("%s: nth must be >= 1 in %r" % (ENV, item))
+    if site not in SITES:
+        import logging
+        logging.getLogger(__name__).warning(
+            "%s: %r names no shipped injection site (catalog: %s) — it "
+            "will never fire unless a custom site calls fire(%r)",
+            ENV, site, ", ".join(sorted(SITES)), site)
+    return _Spec(site, nth, kind)
+
+
+def _parse_env() -> List[_Spec]:
+    specs: List[_Spec] = []
+    raw = os.environ.get(ENV, "")
+    for item in raw.split(","):
+        if item.strip():
+            specs.append(_parse_one(item))
+    legacy = os.environ.get(LEGACY_ENV, "")
+    if legacy.strip():
+        # PR 5's crash hook, generalized: <point>@<n> == SIGKILL at the
+        # n-th arrival of the writer point
+        specs.append(_parse_one("ckpt." + legacy.strip(),
+                                default_kind="sigkill"))
+    return specs
+
+
+def install(spec: str) -> None:
+    """Arm fault injection in-process (tests and the
+    ``mx.config.set("MXNET_TPU_FAULTS", ...)`` override): same grammar
+    as the env var. Replaces any previously installed spec and resets
+    arrival counts; the programmatic spec is authoritative from here on
+    — env vars can no longer (re-)arm (``install("")`` disarms for
+    good, matching config's override-beats-environment precedence)."""
+    global ARMED, _env_disarmed
+    parsed = [_parse_one(s) for s in spec.split(",") if s.strip()]
+    with _lock:
+        _specs[:] = parsed
+        _hits.clear()
+        ARMED = bool(_specs)
+        _env_disarmed = True
+
+
+def clear() -> None:
+    """Disarm all in-process faults and reset arrival counts. Final:
+    env-derived specs do not re-arm after an explicit clear()."""
+    global ARMED, _env_disarmed
+    with _lock:
+        _specs[:] = []
+        _hits.clear()
+        ARMED = False
+        _env_disarmed = True
+
+
+def active_specs() -> List[str]:
+    with _lock:
+        return [repr(s) for s in _specs]
+
+
+def armed_or_env() -> bool:
+    """COLD-path arming check (checkpoint writer/reader sites): also
+    notices the env vars being set *after* import — the runtime-arming
+    pattern the legacy ``MXNET_TPU_CKPT_TEST_CRASH`` hook supported
+    (set the env, then trigger a save in the same process). Re-parses
+    the environment at most once per arming. Hot-path sites
+    (``fit.batch``, ``serve.submit``) check :data:`ARMED` alone."""
+    global ARMED
+    if ARMED:
+        return True
+    if _env_disarmed:
+        return False
+    if not (os.environ.get(ENV) or os.environ.get(LEGACY_ENV)):
+        return False
+    specs = _parse_env()
+    with _lock:
+        if specs and not _specs and not _env_disarmed:
+            _specs[:] = specs
+            _hits.clear()
+            ARMED = True
+    return ARMED
+
+
+def _corrupt_file(path: str, kind: str) -> None:
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size == 0:
+        return
+    if kind == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        return
+    with open(path, "r+b") as f:          # bitflip
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
+
+def fire(site: str, path: Optional[str] = None,
+         default_kind: str = "raise") -> None:
+    """Arrival at an injection point: fires the matching spec, if any.
+
+    Call sites guard with ``if faults.ARMED:`` so a disarmed process
+    pays one bool read. ``path`` is the file the site is about to
+    touch (required by ``bitflip``/``truncate`` kinds)."""
+    with _lock:
+        if not _specs:
+            return
+        _hits[site] = _hits.get(site, 0) + 1
+        count = _hits[site]
+        match = None
+        for spec in _specs:
+            if spec.site != site:
+                continue
+            if spec.nth is None or spec.nth == count:
+                match = spec
+                break
+        if match is None:
+            return
+        kind = match.kind or default_kind
+    # act OUTSIDE the lock: raising/killing while holding it would wedge
+    # a concurrent arrival on another thread
+    from . import profiler as _profiler
+    _profiler.incr_counter("fault_injected")
+    _profiler.incr_counter("fault_injected.%s" % site)
+    if kind in _ERRNO:
+        raise OSError(_ERRNO[kind],
+                      "injected %s fault at %s" % (kind, site),
+                      path or site)
+    if kind == "raise":
+        raise FaultInjected("injected fault at %s" % site)
+    if kind == "sigterm":
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if kind == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        return
+    if kind in ("bitflip", "truncate"):
+        if path is None:
+            raise FaultInjected(
+                "site %s cannot apply %r (no file)" % (site, kind))
+        _corrupt_file(path, kind)
+        return
+    raise FaultInjected("injected fault at %s (unmapped kind %r)"
+                        % (site, kind))
+
+
+# arm from the environment at import (subprocess drills set the env
+# before python starts; in-process tests use install()/clear())
+_env_specs = _parse_env()
+if _env_specs:
+    _specs.extend(_env_specs)
+    ARMED = True
+del _env_specs
+
+# mx.config.set("MXNET_TPU_FAULTS", spec) is a documented runtime
+# override: route it through install() (empty value disarms)
+try:
+    from . import config as _config
+    _config.on_change(ENV, install)
+except Exception:                                          # noqa: BLE001
+    pass    # config not registered yet (standalone import order)

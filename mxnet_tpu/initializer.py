@@ -53,6 +53,24 @@ class Initializer(object):
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
+        self._rng = None
+
+    def set_rng(self, rng) -> "Initializer":
+        """Route this initializer's random draws through an explicit
+        numpy ``Generator`` instead of the process-global ``np.random``
+        state — ``fit``'s default initializer passes one derived from
+        the seeded ``mx.random`` key chain
+        (``mx.random.derive_numpy_rng``), making identically-seeded runs
+        draw identical initial weights. Returns ``self`` for chaining."""
+        self._rng = rng
+        return self
+
+    @property
+    def rng(self):
+        """The random source draws come from: the generator installed by
+        :meth:`set_rng`, else the legacy global ``np.random`` module
+        (both expose ``uniform``/``normal``)."""
+        return self._rng if self._rng is not None else np.random
 
     def dumps(self) -> str:
         """(reference: initializer.py dumps — JSON [name, kwargs])."""
@@ -220,7 +238,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        arr[:] = nd.array(np.random.uniform(-self.scale, self.scale,
+        arr[:] = nd.array(self.rng.uniform(-self.scale, self.scale,
                                             arr.shape).astype(np.float32))
 
 
@@ -233,7 +251,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        arr[:] = nd.array(np.random.normal(0, self.sigma,
+        arr[:] = nd.array(self.rng.normal(0, self.sigma,
                                            arr.shape).astype(np.float32))
 
 
@@ -250,9 +268,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = self.rng.uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = self.rng.normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         res = u if u.shape == tmp.shape else v
         arr[:] = nd.array(self.scale * res.reshape(arr.shape).astype(np.float32))
@@ -290,10 +308,10 @@ class Xavier(Initializer):
             raise ValueError("Incorrect factor type")
         scale = np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = nd.array(np.random.uniform(-scale, scale,
+            arr[:] = nd.array(self.rng.uniform(-scale, scale,
                                                 shape).astype(np.float32))
         elif self.rnd_type == "gaussian":
-            arr[:] = nd.array(np.random.normal(0, scale,
+            arr[:] = nd.array(self.rng.normal(0, scale,
                                                shape).astype(np.float32))
         else:
             raise ValueError("Unknown random type")

@@ -14,6 +14,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..base import MXNetError
+from .. import faults as _faults
 from .. import metric as _metric
 from .. import ndarray as nd
 from .. import profiler as _profiler
@@ -299,8 +300,15 @@ class BaseModule(object):
         from ..initializer import Uniform
         from .. import config as _config
         from .. import _fused as _fused_mod
+        from .. import random as _random
         if initializer is None:
-            initializer = Uniform(0.01)
+            # the default initializer draws from the SEEDED mx.random key
+            # chain (one split), not the process-global unseeded
+            # np.random — two fits after the same mx.random.seed() start
+            # from identical weights (the masked-flake source documented
+            # in CHANGES PR 4)
+            initializer = Uniform(0.01).set_rng(
+                _random.derive_numpy_rng("fit_default_init"))
 
         # --------------------------------------------- checkpoint / resume
         ckpt_mod = None
@@ -460,6 +468,11 @@ class BaseModule(object):
                         # epoch-end processing the interrupted run missed
                         end_of_batch = True
                 while not end_of_batch:
+                    if _faults.ARMED:
+                        # deterministic preemption/crash drills: the
+                        # elastic suite SIGTERMs/SIGKILLs fit at batch K
+                        # (MXNET_TPU_FAULTS=fit.batch@K[:kind])
+                        _faults.fire("fit.batch", default_kind="sigterm")
                     data_batch = next_data_batch
                     # the batch's flow id threads its trace slices across
                     # lanes (prefetch -> place -> step -> metric); batches

@@ -276,17 +276,12 @@ class Module(BaseModule):
     def _sharding_for(self, name):
         """Resolve a parameter's NamedSharding: an exact or regex match in
         param_shardings wins (tensor parallel), else replicated (data
-        parallel)."""
+        parallel). Delegates to the canonical resolver shared with
+        checkpoint reshard-on-load (parallel.mesh.resolve_layout_spec)."""
         from jax.sharding import NamedSharding
-        from ..parallel.mesh import replicated_sharding
+        from ..parallel.mesh import replicated_sharding, resolve_layout_spec
         if self._param_shardings:
-            import re
-            spec = self._param_shardings.get(name)
-            if spec is None:
-                for pat, s in self._param_shardings.items():
-                    if re.fullmatch(pat, name):
-                        spec = s
-                        break
+            spec = resolve_layout_spec(self._param_shardings, name)
             if spec is not None:
                 return NamedSharding(self._mesh, spec)
         return replicated_sharding(self._mesh)
@@ -294,11 +289,22 @@ class Module(BaseModule):
     def _replicate_params(self):
         """Place parameters on the mesh: replicated over ``data``, and
         partitioned per param_shardings over ``model`` (replaces per-device
-        param copies in executor_group.py + kvstore broadcast)."""
+        param copies in executor_group.py + kvstore broadcast). Spec
+        divisibility is validated per parameter first, so restoring a
+        checkpoint onto a mesh its layout cannot divide fails naming the
+        offending array (the elastic reshard-on-load contract) instead
+        of surfacing as an XLA sharding error."""
+        from ..parallel.mesh import validate_spec
         for d in (self._exec.arg_dict, self._exec.aux_dict):
             for name, arr in d.items():
-                arr._data = jax.device_put(arr._data,
-                                           self._sharding_for(name))
+                sharding = self._sharding_for(name)
+                try:
+                    validate_spec(self._mesh, sharding.spec,
+                                  tuple(arr.shape), name=name)
+                except ValueError as exc:
+                    raise MXNetError("cannot lay out parameters on the "
+                                     "bound mesh: %s" % exc) from None
+                arr._data = jax.device_put(arr._data, sharding)
 
     # ------------------------------------------------------------- binding
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -610,6 +616,14 @@ class Module(BaseModule):
         from .. import random as _random
         tensors["rng:global_key"] = key_to_array(_random.current_key())
 
+        # mesh provenance for elastic resume: a restore onto a DIFFERENT
+        # mesh is legitimate (reshard-on-load) but worth counting/logging
+        if self._mesh is not None:
+            from ..parallel.mesh import axis_sizes
+            meta["mesh"] = axis_sizes(self._mesh)
+        meta["world_size"] = int(self._mesh.devices.size) \
+            if self._mesh is not None else 1
+
         # protect every captured device buffer in ONE jitted copy program
         # (a single dispatch instead of ~2 per-op milliseconds per array
         # — measurably the difference between ~10% and ~40% of the write
@@ -635,6 +649,26 @@ class Module(BaseModule):
         from ..checkpoint.manager import array_to_key, tree_decode
         from ..checkpoint.format import CheckpointCorrupt
         tensors = ckpt.tensors
+
+        # elastic resume accounting: restoring onto a different mesh /
+        # world size than the save is the reshard-on-load path — the
+        # host tensors were reassembled from the recorded index windows
+        # and init_params/_replicate_params re-lay them out per THIS
+        # module's mesh and param_shardings
+        from ..parallel.mesh import axis_sizes
+        saved_mesh = ckpt.meta.get("mesh")
+        saved_world = ckpt.meta.get("world_size")
+        cur_mesh = axis_sizes(self._mesh) if self._mesh is not None \
+            else None
+        cur_world = int(self._mesh.devices.size) \
+            if self._mesh is not None else 1
+        if saved_world is not None and \
+                (saved_mesh, int(saved_world)) != (cur_mesh, cur_world):
+            _profiler.incr_counter("elastic_reshard")
+            self.logger.info(
+                "resume: resharding checkpoint saved on mesh %s "
+                "(world %s) onto mesh %s (world %d)",
+                saved_mesh, saved_world, cur_mesh, cur_world)
         opt_meta = ckpt.meta.get("optimizer") or {}
         kind = opt_meta.get("kind")
         if kind == "fused":

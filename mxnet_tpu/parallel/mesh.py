@@ -11,7 +11,9 @@ collectives over ICI.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, \
+    Union
 
 import numpy as np
 import jax
@@ -21,7 +23,31 @@ from ..context import Context
 
 __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "replicated_sharding", "shard_batch", "replicate", "P", "Mesh",
-           "NamedSharding", "mesh_devices", "sharding_island"]
+           "NamedSharding", "mesh_devices", "sharding_island",
+           "axis_sizes", "validate_spec", "resolve_layout_spec"]
+
+# a layout maps array name -> PartitionSpec: a dict (exact name match
+# wins, then regex fullmatch), a callable name -> spec, or None
+# (everything fully replicated)
+Layout = Union[None, Dict[str, Any], Callable[[str], Any]]
+
+
+def resolve_layout_spec(layout: Layout, name: str):
+    """Resolve one array's partition spec from a layout — THE canonical
+    name->spec resolution, shared by ``Module(param_shardings=...)``
+    bind-time placement and checkpoint reshard-on-load (two copies of
+    this precedence once drifted in the PR 8 spec-conflict audit; keep
+    it single-sourced). ``None`` = replicated."""
+    if layout is None:
+        return None
+    if callable(layout):
+        return layout(name)
+    spec = layout.get(name)
+    if spec is None:
+        for pat, s in layout.items():
+            if re.fullmatch(pat, name):
+                return s
+    return spec
 
 
 def sharding_island():
@@ -73,6 +99,45 @@ def make_mesh(shape: Optional[Dict[str, int]] = None,
                              % (total, len(devs)))
     arr = np.array(devs[:total]).reshape(sizes)
     return Mesh(arr, tuple(names))
+
+
+def axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    """Axis name -> size of a named mesh."""
+    return {str(a): int(s)
+            for a, s in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def validate_spec(mesh: Mesh, spec, shape: Tuple[int, ...],
+                  name: str = "<array>") -> None:
+    """Reject a PartitionSpec that cannot lay ``shape`` out on ``mesh``:
+    unknown axis names, or a sharded dimension the axis sizes do not
+    divide. The error NAMES the offending array — elastic reshard-on-load
+    and ``Module`` param placement both route here so an N-chip
+    checkpoint restored onto an incompatible M-chip mesh fails with the
+    array and dimension spelled out, not a shape error deep inside XLA.
+    """
+    sizes = axis_sizes(mesh)
+    parts = tuple(spec) if spec is not None else ()
+    if len(parts) > len(shape):
+        raise ValueError(
+            "%s: partition spec %s has rank %d but array has rank %d"
+            % (name, parts, len(parts), len(shape)))
+    for dim, part in enumerate(parts):
+        if part is None:
+            continue
+        axes = part if isinstance(part, tuple) else (part,)
+        k = 1
+        for a in axes:
+            if a not in sizes:
+                raise ValueError(
+                    "%s: partition spec names axis %r but mesh %r has "
+                    "axes %s" % (name, a, dict(sizes), sorted(sizes)))
+            k *= sizes[a]
+        if shape[dim] % k:
+            raise ValueError(
+                "%s: dimension %d of shape %s is not divisible by the "
+                "%d-way sharding over axes %r (mesh %r)"
+                % (name, dim, tuple(shape), k, axes, dict(sizes)))
 
 
 def data_parallel_mesh(contexts: Sequence[Context]) -> Mesh:

@@ -9,10 +9,13 @@ which device or mesh runs the op.
 from __future__ import annotations
 
 import threading
+import zlib
 
 import jax
+import numpy as np
 
-__all__ = ["seed", "next_key", "current_key", "set_key"]
+__all__ = ["seed", "next_key", "current_key", "set_key",
+           "derive_numpy_rng"]
 
 _state = threading.local()
 
@@ -38,6 +41,26 @@ def next_key():
 
 def current_key():
     return _key()
+
+
+def derive_numpy_rng(tag: str = "") -> np.random.Generator:
+    """A numpy ``Generator`` deterministically derived from the global
+    key chain (one ``split`` is consumed, optionally folded with
+    ``tag``) — the bridge for host-side numpy randomness that must
+    follow ``mx.random.seed``. ``fit``'s default parameter initializer
+    routes here, so two identically-seeded fits draw identical initial
+    weights; before this it read the process-global unseeded
+    ``np.random`` (the masked flake source documented in CHANGES PR 4).
+    """
+    sub = next_key()
+    if tag:
+        sub = jax.random.fold_in(sub, zlib.crc32(tag.encode()) & 0x7FFFFFFF)
+    try:
+        data = jax.random.key_data(sub)     # typed key array
+    except (AttributeError, TypeError):
+        data = sub                          # raw uint32 vector key
+    seed_words = [int(x) for x in np.asarray(data).ravel()]
+    return np.random.default_rng(seed_words)
 
 
 def set_key(key) -> None:

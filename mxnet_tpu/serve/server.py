@@ -326,6 +326,11 @@ class InferenceServer:
         admission bound, :class:`ServerClosed` after ``close()``.
         """
         from .. import config as _config
+        from .. import faults as _faults
+        if _faults.ARMED:
+            # robustness drill: an injected submit failure must surface
+            # on THIS request only — the server keeps serving
+            _faults.fire("serve.submit", default_kind="raise")
         x = np.asarray(data.asnumpy() if isinstance(data, nd_mod.NDArray)
                        else data)
         if batched:

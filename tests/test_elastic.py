@@ -1,0 +1,512 @@
+"""Elastic training + deterministic fault injection (ISSUE 10).
+
+Three contracts under test:
+
+* **supervisor** (``mxnet_tpu.elastic``) — exit 143 and crashes restart
+  the child bounded times with backoff; exit 0 ends the run; the world
+  schedule rewrites the child's device count per attempt and counts
+  reshards; a clean child never restarts.
+* **fault harness** (``mxnet_tpu.faults``) — the
+  ``MXNET_TPU_FAULTS=<site>@<nth>[:kind]`` grammar, arrival counting,
+  the legacy ``MXNET_TPU_CKPT_TEST_CRASH`` alias, and zero-cost when
+  disarmed.
+* **fault matrix** — every recovery path driven under an injected
+  fault: transient writer IO errors are retried and the save still
+  lands (``ckpt_write_retry``), persistent errors surface at close,
+  read-side bit-rot/truncation falls back to the previous checkpoint,
+  a SIGTERM/SIGKILL'd fit resumes to the SAME trained params as an
+  uninterrupted run, and an injected serve.submit failure hurts one
+  request only.
+"""
+import errno
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import elastic, faults, profiler
+from mxnet_tpu.checkpoint import (CheckpointConfig, CheckpointManager,
+                                  CheckpointNotFound, list_checkpoints,
+                                  load_latest, write_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------------ the grammar
+
+def test_faults_grammar_and_arrival_counting():
+    faults.install("x.site@2:raise")
+    faults.fire("x.site")                       # arrival 1: silent
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("x.site")                   # arrival 2: fires
+    faults.fire("x.site")                       # arrival 3: silent again
+
+
+def test_faults_every_arrival_without_nth():
+    faults.install("y.site:eio")
+    for _ in range(3):
+        with pytest.raises(OSError) as ei:
+            faults.fire("y.site")
+        assert ei.value.errno == errno.EIO
+
+
+def test_faults_default_kind_comes_from_site():
+    faults.install("z.site@1")                  # no kind in the spec
+    with pytest.raises(OSError) as ei:
+        faults.fire("z.site", default_kind="enospc")
+    assert ei.value.errno == errno.ENOSPC
+
+
+def test_faults_reject_unknown_kind_and_bad_nth():
+    with pytest.raises(ValueError):
+        faults.install("a.b@1:frobnicate")
+    with pytest.raises(ValueError):
+        faults.install("a.b@0:eio")
+    assert not faults.ARMED                     # bad install arms nothing
+
+
+def test_faults_disarmed_is_silent_and_counterless():
+    assert not faults.ARMED
+    before = profiler.get_counter("fault_injected")
+    faults.fire("ckpt.arrays_write")            # no spec installed
+    assert profiler.get_counter("fault_injected") == before
+
+
+def test_clear_is_final_against_env_rearming(monkeypatch):
+    """A one-shot @nth env fault must not resurrect with fresh arrival
+    counts after an explicit clear() (it would fire a second time)."""
+    monkeypatch.setenv(faults.ENV, "ckpt.read_manifest@1:bitflip")
+    faults.clear()
+    assert not faults.armed_or_env()
+    assert not faults.ARMED
+
+
+def test_config_set_routes_through_install():
+    from mxnet_tpu import config as cfg
+    cfg.set("MXNET_TPU_FAULTS", "q.site@1:raise")
+    try:
+        assert faults.ARMED
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("q.site")
+        cfg.set("MXNET_TPU_FAULTS", "")
+        assert not faults.ARMED
+    finally:
+        cfg.reset("MXNET_TPU_FAULTS")
+
+
+def test_install_empty_disarms_against_env_too(monkeypatch):
+    """mx.config.set('MXNET_TPU_FAULTS','') must disarm FOR GOOD even
+    when the env var is still set — the programmatic override wins and
+    armed_or_env() must not resurrect the env spec with fresh counts."""
+    monkeypatch.setenv(faults.ENV, "ckpt.read_manifest@1:bitflip")
+    faults.install("")
+    assert not faults.armed_or_env()
+    assert not faults.ARMED
+
+
+def test_legacy_ckpt_crash_env_maps_to_sigkill_site(tmp_path):
+    """MXNET_TPU_CKPT_TEST_CRASH=<point>@<n> still SIGKILLs the writer at
+    the n-th arrival (the PR 5 drills keep working unchanged)."""
+    child = (
+        "import os, sys; sys.path.insert(0, %r); "
+        "os.environ['JAX_PLATFORMS']='cpu'; "
+        "import numpy as np; "
+        "from mxnet_tpu.checkpoint import write_checkpoint; "
+        "write_checkpoint(%r, 1, {'x': np.ones(4, np.float32)}); "
+        "write_checkpoint(%r, 2, {'x': np.ones(4, np.float32)}); "
+        "print('SECOND-SAVE-LANDED')"
+        % (REPO, str(tmp_path), str(tmp_path)))
+    proc = subprocess.run(
+        [sys.executable, "-c", child], capture_output=True, text=True,
+        timeout=240,
+        env={**os.environ, "PYTHONPATH": "",
+             "MXNET_TPU_CKPT_TEST_CRASH": "after_arrays@2"})
+    assert proc.returncode == -signal.SIGKILL, \
+        proc.stdout + proc.stderr
+    assert "SECOND-SAVE-LANDED" not in proc.stdout
+    steps = [s for s, _ in list_checkpoints(str(tmp_path))]
+    assert steps == [1]
+
+
+# --------------------------------------------------- writer retry (matrix)
+
+def _mgr(tmp_path, **kw):
+    kw.setdefault("retry_backoff", 0.01)
+    kw.setdefault("async_save", False)
+    return CheckpointManager(CheckpointConfig(str(tmp_path), **kw))
+
+
+def test_two_transient_failures_still_land_the_save(tmp_path):
+    """The satellite contract: EIO then ENOSPC on consecutive attempts,
+    and the bounded retry still lands a fully valid checkpoint."""
+    faults.install("ckpt.arrays_write@1:eio,ckpt.arrays_write@2:enospc")
+    mgr = _mgr(tmp_path, write_retries=3)
+    before = profiler.get_counter("ckpt_write_retry")
+    mgr.save({"w": np.arange(8, dtype=np.float32)}, {}, step=1)
+    mgr.close()
+    assert profiler.get_counter("ckpt_write_retry") - before == 2
+    path, tensors, _m = load_latest(str(tmp_path))
+    assert np.array_equal(tensors["w"], np.arange(8, dtype=np.float32))
+    # no torn residue survives the failed attempts
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.startswith(".tmp-")]
+
+
+def test_eintr_is_retried_too(tmp_path):
+    faults.install("ckpt.arrays_write@1:eintr")
+    mgr = _mgr(tmp_path, write_retries=1)
+    mgr.save({"w": np.ones(4, np.float32)}, {}, step=1)
+    mgr.close()
+    assert list_checkpoints(str(tmp_path))
+
+
+def test_persistent_failure_exhausts_retries_sync(tmp_path):
+    faults.install("ckpt.arrays_write:enospc")       # every arrival
+    mgr = _mgr(tmp_path, write_retries=2)
+    with pytest.raises(OSError) as ei:
+        mgr.save({"w": np.ones(4, np.float32)}, {}, step=1)
+    assert ei.value.errno == errno.ENOSPC
+    mgr.close()
+    assert not list_checkpoints(str(tmp_path))
+
+
+def test_persistent_failure_surfaces_at_close_async(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointError
+    faults.install("ckpt.arrays_write:eio")
+    mgr = _mgr(tmp_path, write_retries=1, async_save=True)
+    mgr.save({"w": np.ones(4, np.float32)}, {}, step=1)
+    mgr.wait()
+    with pytest.raises(CheckpointError):
+        mgr.close()
+
+
+def test_non_transient_oserror_is_not_retried(tmp_path, monkeypatch):
+    from mxnet_tpu.checkpoint import format as fmt
+    calls = [0]
+    real = fmt.write_checkpoint
+
+    def boom(*a, **kw):
+        calls[0] += 1
+        raise OSError(errno.EACCES, "permission denied")
+
+    monkeypatch.setattr(fmt, "write_checkpoint", boom)
+    mgr = _mgr(tmp_path, write_retries=3)
+    with pytest.raises(OSError):
+        mgr.save({"w": np.ones(4, np.float32)}, {}, step=1)
+    assert calls[0] == 1
+    monkeypatch.setattr(fmt, "write_checkpoint", real)
+    mgr.close()
+
+
+# ------------------------------------------------- read-side bit-rot drill
+
+def test_manifest_bitflip_falls_back_to_previous(tmp_path):
+    write_checkpoint(str(tmp_path), 1, {"w": np.full(8, 1.0, np.float32)})
+    write_checkpoint(str(tmp_path), 2, {"w": np.full(8, 2.0, np.float32)})
+    before = profiler.get_counter("ckpt_load_fallback")
+    faults.install("ckpt.read_manifest@1:bitflip")
+    path, tensors, _m = load_latest(str(tmp_path))
+    assert path.endswith("ckpt-0000000001")
+    assert tensors["w"][0] == 1.0
+    assert profiler.get_counter("ckpt_load_fallback") - before == 1
+
+
+def test_arrays_truncation_falls_back_to_previous(tmp_path):
+    write_checkpoint(str(tmp_path), 1, {"w": np.full(64, 1.0, np.float32)})
+    write_checkpoint(str(tmp_path), 2, {"w": np.full(64, 2.0, np.float32)})
+    faults.install("ckpt.read_arrays@1:truncate")
+    path, tensors, _m = load_latest(str(tmp_path))
+    assert path.endswith("ckpt-0000000001")
+    assert tensors["w"][0] == 1.0
+
+
+def test_all_candidates_rotted_raises_not_found(tmp_path):
+    write_checkpoint(str(tmp_path), 1, {"w": np.ones(64, np.float32)})
+    faults.install("ckpt.read_arrays:bitflip")       # every arrival
+    with pytest.raises(CheckpointNotFound):
+        load_latest(str(tmp_path))
+
+
+# -------------------------------------------- kill-kind fit drills (matrix)
+
+_KILL_CHILD = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import mxnet_tpu as mx
+
+rng = np.random.RandomState(0)
+X = rng.uniform(-1, 1, (64, 16)).astype(np.float32)
+Y = rng.randint(0, 8, (64,)).astype(np.float32)
+mx.random.seed(7)
+sym = mx.sym.SoftmaxOutput(
+    mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                          name="fc1"), name="softmax")
+it = mx.io.NDArrayIter(X, Y, batch_size=8)
+mod = mx.mod.Module(sym, context=mx.cpu())
+cfg = mx.checkpoint.CheckpointConfig(%(base)r, every_n_batches=2,
+                                     period_epochs=1)
+mod.fit(it, num_epoch=4, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1}, checkpoint=cfg)
+print("FINISHED-WITHOUT-FAULT")
+"""
+
+
+def _run_kill_child(base, fault):
+    return subprocess.run(
+        [sys.executable, "-c",
+         _KILL_CHILD % {"repo": REPO, "base": base}],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": "", "MXNET_TPU_FAULTS": fault})
+
+
+def _resume_and_reference(base):
+    """Finish the interrupted run from ``base`` and run the uninterrupted
+    twin; returns (resumed, reference) param dicts."""
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (64, 16)).astype(np.float32)
+    Y = rng.randint(0, 8, (64,)).astype(np.float32)
+
+    def fit(resume):
+        mx.random.seed(7)
+        sym = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                  name="fc1"), name="softmax")
+        it = mx.io.NDArrayIter(X, Y, batch_size=8)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.fit(it, num_epoch=4, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                resume_from=resume)
+        arg, aux = mod.get_params()
+        w = {k: v.asnumpy().copy() for k, v in arg.items()}
+        w.update({k: v.asnumpy().copy() for k, v in aux.items()})
+        return w
+
+    return fit(base), fit(None)
+
+
+@pytest.mark.parametrize("kind,expect_rc", [
+    ("sigterm", 143),                 # preemption notice: clean save+143
+    ("sigkill", -signal.SIGKILL),     # hard kill between batches
+])
+def test_fit_batch_kill_then_resume_matches_uninterrupted(
+        tmp_path, kind, expect_rc):
+    """The matrix acceptance: a fit killed at batch K by either signal
+    kind resumes from its checkpoints to the SAME trained params as a
+    never-interrupted run (default initializer included — it draws from
+    the seeded mx.random chain, so the reference run and the killed run
+    start identically)."""
+    base = str(tmp_path)
+    proc = _run_kill_child(base, "fit.batch@13:%s" % kind)
+    assert proc.returncode == expect_rc, proc.stdout + proc.stderr
+    assert "FINISHED-WITHOUT-FAULT" not in proc.stdout
+    assert list_checkpoints(base), "no checkpoint survived the kill"
+    resumed, reference = _resume_and_reference(base)
+    assert set(resumed) == set(reference)
+    for k in sorted(reference):
+        np.testing.assert_array_equal(resumed[k], reference[k], err_msg=k)
+
+
+# --------------------------------------------------------- serve (matrix)
+
+def test_serve_submit_fault_hurts_one_request_only():
+    from mxnet_tpu import serve
+    from mxnet_tpu.gluon import nn
+    net = nn.Sequential()
+    net.add(nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.zeros((1, 16), np.float32)))
+    srv = serve.InferenceServer(net, max_batch_size=8,
+                                name="serve_t_fault")
+    try:
+        x = np.ones(16, np.float32)
+        ok1 = srv.submit(x).result(timeout=60)
+        faults.install("serve.submit@1:raise")
+        with pytest.raises(faults.FaultInjected):
+            srv.submit(x)
+        ok2 = srv.submit(x).result(timeout=60)   # server still serves
+        assert np.array_equal(np.asarray(ok1), np.asarray(ok2))
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------- the supervisor
+
+_OK_AFTER = r"""
+import json, os, sys
+state = %(state)r
+n = 0
+if os.path.exists(state):
+    n = json.load(open(state))["runs"]
+json.dump({"runs": n + 1,
+           "attempt": os.environ.get("MXNET_TPU_ELASTIC_ATTEMPT"),
+           "resumed": os.environ.get("MXNET_TPU_ELASTIC_RESUMED"),
+           "xla": os.environ.get("XLA_FLAGS", "")},
+          open(state, "w"))
+sys.exit(0 if n + 1 >= %(succeed_on)d else %(rc)d)
+"""
+
+
+def _script(tmp_path, body):
+    p = tmp_path / "child.py"
+    p.write_text(body)
+    return str(p)
+
+
+def test_supervisor_restarts_until_success(tmp_path):
+    state = str(tmp_path / "state.json")
+    child = _script(tmp_path, _OK_AFTER
+                    % {"state": state, "succeed_on": 3, "rc": 143})
+    sup = elastic.Supervisor([child], max_restarts=5, backoff=0.01,
+                             backoff_max=0.02, jitter_seed=0,
+                             world_schedule=[8, 4, 2])
+    assert sup.run() == 0
+    assert sup.restarts == 2
+    assert sup.reshards == 2
+    import json
+    rec = json.load(open(state))
+    assert rec["runs"] == 3
+    assert rec["attempt"] == "2"
+    assert rec["resumed"] == "1"
+    assert "--xla_force_host_platform_device_count=2" in rec["xla"]
+
+
+def test_supervisor_crash_rc_also_restarts(tmp_path):
+    state = str(tmp_path / "state.json")
+    child = _script(tmp_path, _OK_AFTER
+                    % {"state": state, "succeed_on": 2, "rc": 17})
+    sup = elastic.Supervisor([child], max_restarts=3, backoff=0.01,
+                             jitter_seed=0)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+
+
+def test_supervisor_budget_exhausted_returns_child_rc(tmp_path):
+    child = _script(tmp_path, "import sys; sys.exit(9)\n")
+    sup = elastic.Supervisor([child], max_restarts=2, backoff=0.01,
+                             backoff_max=0.02, jitter_seed=0)
+    assert sup.run() == 9
+    assert sup.restarts == 2
+
+
+def test_supervisor_clean_child_never_restarts(tmp_path):
+    child = _script(tmp_path, "import sys; sys.exit(0)\n")
+    sup = elastic.Supervisor([child], max_restarts=3, backoff=0.01)
+    assert sup.run() == 0
+    assert sup.restarts == 0
+
+
+def test_backoff_sleep_interruptible_by_termination(tmp_path):
+    """A SIGTERM mid-backoff must cut the sleep short (PEP 475 would
+    resume one long sleep after the flag-only handler returns)."""
+    import threading
+    import time as _time
+    child = _script(tmp_path, "import sys; sys.exit(0)\n")
+    sup = elastic.Supervisor([child], backoff=0.01)
+    threading.Timer(0.1, lambda: setattr(sup, "_terminated", True)).start()
+    t0 = _time.monotonic()
+    sup._backoff_sleep(30.0)
+    assert _time.monotonic() - t0 < 5.0
+
+
+def test_supervisor_sigterm_between_attempts_stops_before_spawn(tmp_path):
+    """A preemption notice that lands while no child is alive (backoff
+    sleep, world probe) must not spawn a fresh child doomed to a hard
+    kill — the supervisor exits 143 without another attempt."""
+    marker = tmp_path / "ran"
+    child = _script(tmp_path,
+                    "import pathlib, sys\n"
+                    "pathlib.Path(%r).touch()\n"
+                    "sys.exit(0)\n" % str(marker))
+    sup = elastic.Supervisor([child], max_restarts=3, backoff=0.01)
+    sup._terminated = True           # SIGTERM arrived between attempts
+    assert sup.run() == 143
+    assert not marker.exists()
+
+
+def test_supervisor_schedule_repeats_last_entry(tmp_path):
+    state = str(tmp_path / "state.json")
+    child = _script(tmp_path, _OK_AFTER
+                    % {"state": state, "succeed_on": 4, "rc": 143})
+    sup = elastic.Supervisor([child], max_restarts=5, backoff=0.01,
+                             backoff_max=0.02, jitter_seed=0,
+                             world_schedule=[4, 2])
+    assert sup.run() == 0
+    assert sup.restarts == 3
+    assert sup.reshards == 1          # 4 -> 2, then 2 repeats
+    import json
+    assert "device_count=2" in json.load(open(state))["xla"]
+
+
+def test_resume_dir_requires_a_valid_checkpoint(tmp_path):
+    assert elastic.resume_dir(str(tmp_path)) is None
+    write_checkpoint(str(tmp_path), 1, {"w": np.ones(4, np.float32)})
+    assert elastic.resume_dir(str(tmp_path)) == str(tmp_path)
+    # corrupt the only candidate: no longer resumable
+    arrays = os.path.join(str(tmp_path), "ckpt-0000000001", "arrays.npz")
+    with open(arrays, "ab") as f:
+        f.write(b"x")                  # size mismatch fails probe_valid
+    assert elastic.resume_dir(str(tmp_path)) is None
+
+
+def test_elastic_cli_entrypoint(tmp_path):
+    child = _script(tmp_path, "import sys; sys.exit(0)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.elastic", "--max-restarts", "1",
+         "--backoff", "0.01", "--", child],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_supervisor_never_initializes_a_jax_backend(tmp_path):
+    """The supervisor's device view must come from throwaway probe
+    subprocesses, never an in-process backend (a backend pins its device
+    set for the process lifetime — fatal for elasticity). Run the whole
+    supervisor + one restart under an unresolvable JAX_PLATFORMS: any
+    in-process backend initialization raises; the child overrides the
+    platform itself and must succeed."""
+    child = _script(tmp_path, (
+        "import json, os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'   # override, not setdefault\n"
+        "state = %r\n"
+        "n = 0\n"
+        "if os.path.exists(state):\n"
+        "    n = json.load(open(state))['runs']\n"
+        "json.dump({'runs': n + 1}, open(state, 'w'))\n"
+        "sys.exit(0 if n + 1 >= 2 else 143)\n"
+        % str(tmp_path / "state.json")))
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.elastic", "--max-restarts", "2",
+         "--backoff", "0.01", "--", child],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "PYTHONPATH": REPO,
+             "JAX_PLATFORMS": "no_such_platform"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------------------------- end-to-end
+
+@pytest.mark.slow
+def test_elastic_smoke_script():
+    """The CI drill end-to-end: 8-device fit preempted mid-epoch,
+    auto-resumed on 4 then 2 devices, final params bit-identical to the
+    uninterrupted 8-device baseline (tools/elastic_smoke.py)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "elastic_smoke.py")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ELASTIC-DRILL-OK" in proc.stdout

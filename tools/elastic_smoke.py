@@ -1,0 +1,195 @@
+"""Elastic kill -> reshard -> resume drill (CI ``elastic`` job, also
+driven by tests/test_elastic.py::test_elastic_smoke_script).
+
+The full ROADMAP-item-4 story in one script:
+
+1. an 8-device data-parallel ``fit`` is SIGTERMed mid-epoch
+   (deterministically: ``MXNET_TPU_FAULTS=fit.batch@K:sigterm``) — the
+   preemption hook lands a final synchronous checkpoint and exits 143;
+2. the ``mxnet_tpu.elastic`` supervisor observes the preemption,
+   re-probes the world, and relaunches the child at 4 devices; the
+   child resumes from the newest valid checkpoint, resharding every
+   array onto the smaller mesh (reshard-on-load);
+3. a second injected preemption drops the world to 2 devices; the
+   third attempt finishes the run;
+4. the final parameters must be BIT-IDENTICAL to an uninterrupted
+   8-device baseline, with ZERO steady-state recompiles after each
+   re-entry (``loop_recompile`` asserted at every batch of every
+   attempt) and both restarts/reshards visible in the supervisor
+   counters;
+5. a knobs-off zero-cost gate: the same child with no ``MXNET_TPU_FAULTS``
+   must run fault-silent (``fault_injected`` == 0, harness disarmed).
+
+Why the model is a one-hot "lookup regression" (FullyConnected over
+one-hot rows + LinearRegressionOutput, no bias): bit-identical params
+across DIFFERENT mesh sizes requires every floating-point reduction to
+be exact regardless of summation order — with disjoint one-hot inputs
+each gradient element receives exactly ONE nonzero contribution, so the
+batch contraction and the cross-device psum are order-independent. The
+drill therefore isolates elastic/reshard/resume correctness from FP
+reduction-order noise (which a change of world size legitimately
+perturbs on real models).
+
+Exit 0 + ``ELASTIC-DRILL-OK`` on success; any assertion kills CI.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+BATCH, NSAMP, FEAT, OUT = 8, 64, 64, 4
+EPOCHS = 3
+SEED = 5
+# faults per supervisor attempt: two preemptions, then run to completion
+ATTEMPT_FAULTS = {0: "fit.batch@12:sigterm", 1: "fit.batch@6:sigterm"}
+WORLD_SCHEDULE = [8, 4, 2]
+
+
+def _data():
+    """One-hot lookup samples: row i is e_{i mod FEAT}; every batch of 8
+    holds disjoint positions (the iterator does not shuffle), so every
+    gradient element has exactly one nonzero contributor — see module
+    docstring."""
+    x = np.eye(FEAT, dtype=np.float32)[np.arange(NSAMP) % FEAT]
+    rng = np.random.RandomState(3)
+    y = rng.uniform(-1, 1, (NSAMP, OUT)).astype(np.float32)
+    return x, y
+
+
+def _symbol():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=OUT, no_bias=True,
+                               name="lut")
+    return mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("label"),
+                                         name="reg")
+
+
+def _train(ckpt_dir=None, out_path=None, check_recompiles=False):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic, profiler
+    mx.random.seed(SEED)
+    ndev = len(jax.devices())
+    X, Y = _data()
+    it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch_size=BATCH)
+    mod = mx.mod.Module(_symbol(), context=[mx.cpu(i) for i in range(ndev)]
+                        if ndev > 1 else mx.cpu(),
+                        data_names=("data",), label_names=("label",))
+    kw = {}
+    if ckpt_dir is not None:
+        kw["checkpoint"] = mx.checkpoint.CheckpointConfig(
+            ckpt_dir, every_n_batches=2, period_epochs=1, keep_last=0)
+        kw["resume_from"] = elastic.resume_dir(ckpt_dir)
+    if check_recompiles:
+        def _no_recompiles(_param):
+            n = profiler.get_counter("loop_recompile")
+            assert n == 0, "steady-state recompile detected (%d)" % n
+        kw["batch_end_callback"] = _no_recompiles
+    mod.fit(it, num_epoch=EPOCHS, eval_metric="mse", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+            **kw)
+    arg, _aux = mod.get_params()
+    w = {k: v.asnumpy() for k, v in arg.items()}
+    if out_path is not None:
+        np.savez(out_path, **w)
+    return ndev, w
+
+
+def _child(ckpt_dir, out_path):
+    from mxnet_tpu import faults, profiler
+    attempt = int(os.environ.get("MXNET_TPU_ELASTIC_ATTEMPT", "0"))
+    spec = ATTEMPT_FAULTS.get(attempt)
+    if spec:
+        faults.install(spec)
+    ndev, _w = _train(ckpt_dir=ckpt_dir, out_path=out_path,
+                      check_recompiles=True)
+    print("ELASTIC-CHILD-DONE world=%d attempt=%d reshard=%d "
+          "recompiles=%d"
+          % (ndev, attempt, profiler.get_counter("elastic_reshard"),
+             profiler.get_counter("loop_recompile")))
+    return 0
+
+
+def _zero_cost():
+    from mxnet_tpu import faults, profiler
+    assert not faults.ARMED, "fault harness armed with no knob set"
+    _train()
+    assert profiler.get_counter("fault_injected") == 0
+    print("ZERO-COST-OK counters=%s"
+          % json.dumps({k: v for k, v in profiler.counters().items()
+                        if k.startswith("fault")}))
+    return 0
+
+
+def main():
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        return _child(sys.argv[i + 1], sys.argv[i + 2])
+    if "--baseline" in sys.argv:
+        _ndev, _w = _train(out_path=sys.argv[sys.argv.index("--baseline")
+                                             + 1])
+        print("BASELINE-DONE")
+        return 0
+    if "--zero-cost" in sys.argv:
+        return _zero_cost()
+
+    from mxnet_tpu import elastic
+    work = tempfile.mkdtemp(prefix="elastic_smoke_")
+    ckpt_base = os.path.join(work, "ckpts")
+    base_npz = os.path.join(work, "baseline.npz")
+    elastic_npz = os.path.join(work, "elastic.npz")
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    env.pop("MXNET_TPU_FAULTS", None)
+    env.pop("MXNET_TPU_CKPT_TEST_CRASH", None)
+
+    # ---- uninterrupted 8-device baseline --------------------------------
+    flags = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--baseline", base_npz],
+        env={**env, "XLA_FLAGS": flags}, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # ---- elastic run: preempt at 8, resume on 4, preempt, finish on 2 ---
+    sup = elastic.Supervisor(
+        [sys.executable, os.path.abspath(__file__), "--child", ckpt_base,
+         elastic_npz],
+        world_schedule=WORLD_SCHEDULE, max_restarts=4, backoff=0.05,
+        backoff_max=0.2, jitter_seed=0, env=env)
+    rc = sup.run()
+    assert rc == 0, "supervisor rc=%d" % rc
+    assert sup.restarts == 2, "expected 2 restarts, got %d" % sup.restarts
+    assert sup.reshards == 2, \
+        "expected 2 world-size changes, got %d" % sup.reshards
+
+    # ---- parity ---------------------------------------------------------
+    ref = dict(np.load(base_npz))
+    got = dict(np.load(elastic_npz))
+    assert set(ref) == set(got), (sorted(ref), sorted(got))
+    for k in sorted(ref):
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+    print("kill->reshard->resume parity: 8 -> 4 -> 2 devices, "
+          "params bit-identical to the uninterrupted 8-device run")
+
+    # ---- knobs-off zero-cost gate ---------------------------------------
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--zero-cost"],
+        env={**env, "XLA_FLAGS": flags}, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ZERO-COST-OK" in proc.stdout
+
+    print("ELASTIC-DRILL-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
