@@ -38,19 +38,22 @@ Typical use::
 from .atomic import atomic_open, fsync_dir, replace_and_sync
 from .format import (ARRAYS_NAME, MANIFEST_NAME, CheckpointCorrupt,
                      CheckpointError, CheckpointNotFound,
+                     CheckpointPodError,
                      collect_garbage, list_checkpoints, load_latest,
-                     probe_valid, read_checkpoint, reshard_tensors,
-                     resolve_layout_spec, write_checkpoint)
+                     pod_info, probe_valid, read_checkpoint,
+                     reshard_tensors, resolve_layout_spec,
+                     write_checkpoint)
 from .manager import (Checkpoint, CheckpointConfig, CheckpointManager,
                       restore_global_rng, restore_latest)
 
 __all__ = [
     "CheckpointConfig", "CheckpointManager", "Checkpoint",
     "CheckpointError", "CheckpointCorrupt", "CheckpointNotFound",
+    "CheckpointPodError",
     "restore_latest", "restore_global_rng",
     "write_checkpoint", "read_checkpoint", "load_latest",
     "reshard_tensors", "resolve_layout_spec",
-    "list_checkpoints", "probe_valid", "collect_garbage",
+    "list_checkpoints", "probe_valid", "collect_garbage", "pod_info",
     "atomic_open", "fsync_dir", "replace_and_sync",
     "ARRAYS_NAME", "MANIFEST_NAME",
 ]

@@ -28,6 +28,21 @@ Sharded arrays (mesh-bound modules): a jax array that is not fully
 replicated is saved **per shard** — one npz entry per distinct shard with
 its index window recorded in the tensor table, alongside the mesh axes and
 partition spec — and reassembled into a full host array on read.
+
+Multi-host pods (ISSUE 11): when a ``jax.distributed`` pod is active,
+the save goes **process-local** — each host writes ONLY the index
+windows it owns into its own ``arrays-p<rank>.npz`` (distinct-window
+ownership is derived from the global device→index map, lowest
+``(process_index, device id)`` wins, so every host computes the same
+partition without communicating), then publishes its shard record to
+the coordination KV store; rank 0 waits for every record (bounded by
+``MXNET_TPU_CKPT_POD_TIMEOUT``), merges them into ONE manifest tagged
+with ``world_size`` + per-entry ``process_index``, and commits with the
+same fsync+rename protocol. A host dying mid-save means rank 0 times
+out and the save aborts AS A UNIT — no partial checkpoint can ever
+commit; ``load_latest`` falls back to the newest complete one. Reads
+reassemble from all per-host files and reshard onto whatever world
+resumes.
 """
 from __future__ import annotations
 
@@ -37,6 +52,7 @@ import logging
 import os
 import re
 import shutil
+import time as _time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -48,10 +64,12 @@ from . import atomic as _atomic
 
 __all__ = [
     "CheckpointError", "CheckpointCorrupt", "CheckpointNotFound",
+    "CheckpointPodError",
     "FORMAT_VERSION", "MANIFEST_NAME", "ARRAYS_NAME",
     "checkpoint_dir_name", "list_checkpoints", "probe_valid",
     "write_checkpoint", "read_manifest", "read_checkpoint", "load_latest",
     "collect_garbage", "resolve_layout_spec", "reshard_tensors",
+    "pod_info",
 ]
 
 FORMAT_VERSION = "mxnet_tpu.checkpoint/1"
@@ -63,6 +81,12 @@ _TMP_PREFIX = ".tmp-"
 # the per-process sequence keeps two writers of the SAME step (a queued
 # async save racing a SIGTERM sync save) off one tmp path
 _TMP_RE = re.compile(r"^\.tmp-ckpt-\d{10}\.(\d+)\.\d+$")
+# .tmp-ckpt-<step>.pod.g<gen> — the shared staging dir of a pod save
+# (every host writes its arrays-p<rank>.npz into it; reaped by
+# collect_garbage once its step finalized, its generation is gone, or
+# it aged out — a dead pod's residue has no live pid to key on)
+_POD_TMP_RE = re.compile(r"^\.tmp-ckpt-(\d{10})\.pod\.g(.+)$")
+_POD_TMP_MAX_AGE = 3600.0
 _TMP_SEQ = itertools.count()
 
 log = logging.getLogger(__name__)
@@ -80,6 +104,32 @@ class CheckpointCorrupt(CheckpointError):
 
 class CheckpointNotFound(CheckpointError):
     """No loadable checkpoint exists under the base directory."""
+
+
+class CheckpointPodError(CheckpointError):
+    """A multi-host save could not complete as a unit (a peer died or
+    wedged mid-save, the commit barrier timed out). The staged files are
+    never renamed into place, so readers never see the partial save; the
+    preemption path treats this as best-effort (the newest COMPLETE
+    checkpoint is the resume point)."""
+
+
+def pod_info() -> Tuple[int, int]:
+    """(rank, world) of the active ``jax.distributed`` pod, (0, 1) when
+    single-process. A pure state probe — never initializes anything and
+    never imports ``mxnet_tpu.parallel.dist`` (the zero-cost gate
+    asserts a plain single-process run stays free of the pod stack)."""
+    import sys
+    if "jax" not in sys.modules:
+        return 0, 1
+    try:
+        from jax._src import distributed as _jdist
+        state = _jdist.global_state
+        if getattr(state, "client", None) is None:
+            return 0, 1
+        return int(state.process_id or 0), int(state.num_processes or 1)
+    except Exception:                                      # noqa: BLE001
+        return 0, 1
 
 
 # Writer injection points for the crash-safety suite, now served by the
@@ -154,6 +204,255 @@ def _decompose(name: str, val: Any, arrays: Dict[str, np.ndarray]
     return {"kind": "sharded", "shape": [int(s) for s in val.shape],
             "dtype": str(np.dtype(val.dtype)), "mesh": mesh, "spec": spec,
             "shards": shards_meta}
+
+
+def _decompose_local(name: str, val: Any, arrays: Dict[str, np.ndarray],
+                     rank: int) -> Optional[Dict[str, Any]]:
+    """Pod variant of :func:`_decompose`: stage only what THIS process
+    owns; returns a partial tensor-table entry (or None when nothing of
+    this tensor lives here).
+
+    Ownership of a distinct index window is the lowest
+    ``(process_index, device id)`` among the devices holding it — derived
+    from the global device→index map, so every host computes the same
+    disjoint partition without communicating. Fully-replicated (and
+    plain host) tensors are owned by rank 0."""
+    if not _is_sharded(val):
+        if rank != 0:
+            return None
+        arrays[name] = np.asarray(val)
+        return {"kind": "full", "key": name, "process_index": 0}
+    sharding = val.sharding
+    try:
+        from ..parallel.mesh import axis_sizes
+        mesh = axis_sizes(sharding.mesh)
+        spec = str(tuple(sharding.spec))
+    except AttributeError:                   # non-NamedSharding
+        mesh, spec = {}, repr(sharding)
+    owners: Dict[Any, Tuple[int, int]] = {}
+    pairs = None
+    try:
+        pairs = [(dev, idx) for dev, idx
+                 in sharding.devices_indices_map(val.shape).items()]
+    except Exception:                                      # noqa: BLE001
+        try:                 # exotic sharding: the global shard view
+            pairs = [(sh.device, sh.index) for sh in val.global_shards]
+        except Exception:                                  # noqa: BLE001
+            # no global window map at all: every host stages its own
+            # distinct local windows. Windows REPLICATED across hosts
+            # get one copy per host (the read-side coverage mask dedups
+            # them), trading bytes for coverage — losing a window
+            # entirely would corrupt the save
+            pairs = None
+    if pairs is not None:
+        for dev, idx in pairs:
+            meta = _shard_index_meta(idx, val.shape)
+            key = tuple(tuple(w) if w else None for w in meta)
+            cand = (int(dev.process_index), int(dev.id))
+            cur = owners.get(key)
+            if cur is None or cand < cur:
+                owners[key] = cand
+    shards_meta = []
+    seen = set()
+    for shard in val.addressable_shards:
+        idx_meta = _shard_index_meta(shard.index, val.shape)
+        key_t = tuple(tuple(w) if w else None for w in idx_meta)
+        if key_t in seen:            # replicated copy of the same window
+            continue
+        owner = owners.get(key_t)
+        if owner is not None and owner[0] != rank:
+            continue                 # a replica some other host owns
+        seen.add(key_t)
+        akey = "%s@p%d.s%d" % (name, rank, len(shards_meta))
+        arrays[akey] = np.asarray(shard.data)
+        shards_meta.append({"key": akey, "index": idx_meta,
+                            "process_index": rank})
+    if not shards_meta:
+        return None
+    return {"kind": "sharded", "shape": [int(s) for s in val.shape],
+            "dtype": str(np.dtype(val.dtype)), "mesh": mesh, "spec": spec,
+            "shards": shards_meta}
+
+
+def _merge_pod_records(step: int, records: Dict[int, Dict[str, Any]],
+                       meta: Optional[Dict[str, Any]], world: int
+                       ) -> Dict[str, Any]:
+    """Rank 0's manifest merge: one manifest over every host's shard
+    record. A record whose (process_index, world_size) tags disagree
+    with this commit is a stale host writing into the wrong generation —
+    rejected here so it can never reach disk."""
+    arrays: Dict[str, Any] = {}
+    tensors: Dict[str, Any] = {}
+    files: Dict[str, int] = {}
+    writers: Dict[str, str] = {}
+    for r in sorted(records):
+        rec = records[r]
+        if int(rec.get("process_index", r)) != r or \
+                int(rec.get("world_size", world)) != world:
+            raise CheckpointPodError(
+                "step %d: shard record of process %d is tagged "
+                "process %s / world %s but this commit is world %d — "
+                "stale host; aborting the save"
+                % (step, r, rec.get("process_index"),
+                   rec.get("world_size"), world))
+        files[rec["file"]] = int(rec["size"])
+        writers[str(r)] = rec["file"]
+        for key, arec in rec["arrays"].items():
+            if key in arrays:
+                raise CheckpointPodError(
+                    "step %d: duplicate array key %r from process %d"
+                    % (step, key, r))
+            arec = dict(arec)
+            arec["file"] = rec["file"]
+            arec["process_index"] = r
+            arrays[key] = arec
+        for name, entry in rec["tensors"].items():
+            if entry["kind"] == "full":
+                tensors[name] = entry
+            elif name not in tensors:
+                tensors[name] = dict(entry, shards=list(entry["shards"]))
+            else:
+                tensors[name]["shards"].extend(entry["shards"])
+    return {
+        "format": FORMAT_VERSION,
+        "step": step,
+        "world_size": world,
+        "writers": writers,
+        "arrays": arrays,
+        "tensors": tensors,
+        "files": files,
+        "meta": meta or {},
+    }
+
+
+def _write_checkpoint_pod(base: str, step: int, tensors: Dict[str, Any],
+                          meta: Optional[Dict[str, Any]], rank: int,
+                          world: int) -> str:
+    """Process-local save: every host writes only its own index windows;
+    rank 0 merges the records and commits the manifest (see module
+    docstring). Checkpoint write cost per host therefore stops scaling
+    with pod size."""
+    from ..parallel import dist as _dist
+    from .. import config as _config
+    timeout = float(_config.get("MXNET_TPU_CKPT_POD_TIMEOUT"))
+    step = int(step)
+    os.makedirs(base, exist_ok=True)
+    final = os.path.join(base, checkpoint_dir_name(step))
+    if os.path.isdir(final) and probe_valid(final):
+        return final     # shared fs: every rank reaches the same answer
+    gen = os.environ.get("MXNET_TPU_POD_GEN", "0")
+    kv_ns = "mxnet_ckpt/g%s/s%010d" % (gen, step)
+    tmp = os.path.join(base, "%sckpt-%010d.pod.g%s"
+                       % (_TMP_PREFIX, step, gen))
+    if rank == 0 and os.path.isdir(final):
+        log.warning("replacing invalid existing checkpoint %s", final)
+        shutil.rmtree(final, ignore_errors=True)
+    os.makedirs(tmp, exist_ok=True)
+    try:
+        arrays: Dict[str, np.ndarray] = {}
+        table: Dict[str, Any] = {}
+        for name, val in tensors.items():
+            entry = _decompose_local(name, val, arrays, rank)
+            if entry is not None:
+                table[name] = entry
+        fname = "arrays-p%d.npz" % rank
+        arrays_path = os.path.join(tmp, fname)
+        if _faults.armed_or_env():
+            _faults.fire("ckpt.arrays_write", path=arrays_path,
+                         default_kind="eio")
+        with open(arrays_path, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        _maybe_crash("after_arrays")
+        record = {
+            "file": fname, "process_index": rank, "world_size": world,
+            "size": os.path.getsize(arrays_path),
+            "arrays": {k: {"shape": [int(s) for s in v.shape],
+                           "dtype": str(v.dtype),
+                           "crc32": _crc32(v),
+                           "nbytes": int(v.nbytes)}
+                       for k, v in arrays.items()},
+            "tensors": table,
+        }
+        _dist.kv_set("%s/p%d" % (kv_ns, rank), json.dumps(record))
+        if rank != 0:
+            # rank-0 manifest commit barrier: the save only "happened"
+            # once rank 0 committed; a bounded wait so a dead rank 0
+            # surfaces as an error, never a hang. The window is TWICE
+            # rank 0's collection window: rank 0 may legitimately spend
+            # the full timeout waiting for the slowest peer's record and
+            # then still needs to audit/write/fsync/rename — a peer
+            # giving up on the same clock as the collector would declare
+            # a checkpoint failed that rank 0 goes on to commit
+            commit = _dist.kv_get("%s/commit" % kv_ns,
+                                  int(timeout * 2 * 1000))
+            if commit is None:
+                raise CheckpointPodError(
+                    "rank 0 never committed checkpoint step %d within "
+                    "%.0fs — the pod save aborted as a unit" % (step,
+                                                                timeout))
+            return final
+        records = {0: record}
+        deadline = _time.monotonic() + timeout
+        for r in range(1, world):
+            left_ms = max(1, int((deadline - _time.monotonic()) * 1000))
+            raw = _dist.kv_get("%s/p%d" % (kv_ns, r), left_ms)
+            if raw is None:
+                raise CheckpointPodError(
+                    "process %d of %d never published its shard record "
+                    "for step %d within %.0fs — a host died or wedged "
+                    "mid-save; aborting the save as a unit (no partial "
+                    "checkpoint can commit)" % (r, world, step, timeout))
+            records[r] = json.loads(raw)
+        # pre-commit staging audit: every record's file must exist on
+        # disk at its recorded size. Peers are blocked on the commit key
+        # and do NOT rewrite on a rank-0 retry, so their KV records can
+        # outlive their files (e.g. a foreign cleanup) — committing a
+        # manifest that references a missing file would be a "successful"
+        # save that can never load
+        for r in sorted(records):
+            fpath = os.path.join(tmp, records[r]["file"])
+            try:
+                size = os.path.getsize(fpath)
+            except OSError:
+                raise CheckpointPodError(
+                    "process %d's shard file %s vanished from the "
+                    "staging dir before the step-%d commit; aborting "
+                    "the save as a unit"
+                    % (r, records[r]["file"], step)) from None
+            if size != int(records[r]["size"]):
+                raise CheckpointPodError(
+                    "process %d's shard file %s is %d bytes on disk "
+                    "but its record says %d; aborting the step-%d save "
+                    "as a unit" % (r, records[r]["file"], size,
+                                   int(records[r]["size"]), step))
+        manifest = _merge_pod_records(step, records, meta, world)
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        _maybe_crash("after_manifest")
+        _atomic.fsync_dir(tmp)
+        _maybe_crash("before_rename")
+        try:
+            os.rename(tmp, final)
+        except OSError:
+            if not os.path.isdir(final):
+                raise
+            shutil.rmtree(tmp, ignore_errors=True)
+        _atomic.fsync_dir(base)
+        _dist.kv_set("%s/commit" % kv_ns, final)
+        return final
+    except BaseException:
+        # do NOT rmtree the shared staging dir — peers' shard files live
+        # in it, and a transient-error retry on this rank re-enters the
+        # SAME dir while peers stay blocked on the commit key (they never
+        # rewrite); deleting their files here would let the retry commit
+        # a manifest referencing vanished files. The dir is never
+        # renamed, so readers never see it; collect_garbage reaps it
+        # (finalized step / stale generation / age).
+        raise
 
 
 def _compose(name: str, entry: Dict[str, Any],
@@ -265,7 +564,16 @@ def write_checkpoint(base: str, step: int, tensors: Dict[str, Any],
     validity probe (bit rot, torn by a foreign tool — the thing resume
     just fell back past) is replaced: it must not block re-checkpointing
     the retraced step forever.
+
+    Under an active ``jax.distributed`` pod this call is COLLECTIVE:
+    every process must make it with the same step, each writes only its
+    own index windows, and rank 0 commits the merged manifest
+    (:func:`_write_checkpoint_pod`).
     """
+    rank, world = pod_info()
+    if world > 1:
+        return _write_checkpoint_pod(base, step, tensors, meta, rank,
+                                     world)
     step = int(step)
     os.makedirs(base, exist_ok=True)
     final = os.path.join(base, checkpoint_dir_name(step))
@@ -365,6 +673,41 @@ def read_manifest(path: str) -> Dict[str, Any]:
     return manifest
 
 
+def _validate_pod_tags(path: str, manifest: Dict[str, Any]) -> None:
+    """Reject a mixed-world save LEGIBLY: every ``process_index`` tag in
+    the manifest (writers map, array records, shard entries) must be
+    consistent with the committed ``world_size``. A violation means a
+    stale host — one still writing with an old generation's world view —
+    contaminated the directory; the error names it so the operator knows
+    which host to hunt, and ``load_latest`` falls back to the previous
+    complete checkpoint instead of failing crc-by-crc."""
+    world = int(manifest.get("world_size", 1) or 1)
+    for r_s, fname in (manifest.get("writers") or {}).items():
+        if int(r_s) >= world:
+            raise CheckpointCorrupt(
+                "%s: %s was written by process %s, but the manifest "
+                "commits world_size=%d — stale host file from a larger "
+                "world; rejecting the save as a unit" % (path, fname,
+                                                         r_s, world))
+    for key, rec in (manifest.get("arrays") or {}).items():
+        p = rec.get("process_index")
+        if p is not None and int(p) >= world:
+            raise CheckpointCorrupt(
+                "%s: array %r (file %s) is tagged process %d of a "
+                "world-%d-or-larger save, but the manifest commits "
+                "world_size=%d — stale host; rejecting the save as a "
+                "unit" % (path, key, rec.get("file", ARRAYS_NAME),
+                          int(p), int(p) + 1, world))
+    for name, entry in (manifest.get("tensors") or {}).items():
+        for sh in entry.get("shards") or []:
+            p = sh.get("process_index")
+            if p is not None and int(p) >= world:
+                raise CheckpointCorrupt(
+                    "%s: tensor %r shard %r is tagged process %d but "
+                    "the manifest commits world_size=%d — stale host"
+                    % (path, name, sh.get("key"), int(p), world))
+
+
 def probe_valid(path: str) -> bool:
     """Cheap validity probe (no checksum pass): manifest parses and the
     container files have the recorded sizes. Used by retention GC so a
@@ -391,33 +734,51 @@ def read_checkpoint(path: str, verify: bool = True, mesh=None,
     exact or regex), every tensor is additionally RE-LAID-OUT onto that
     mesh after reassembly (:func:`reshard_tensors`) — the checkpoint may
     have been saved from a completely different mesh shape/spec; each
-    source shard is checksum-verified before it contributes."""
+    source shard is checksum-verified before it contributes.
+
+    Pod checkpoints (several ``arrays-p<rank>.npz`` containers) are
+    reassembled from every per-host file; a manifest whose
+    ``process_index`` tags exceed its committed ``world_size`` is a
+    mixed-world partial save (a stale host wrote into the directory) and
+    is rejected as a unit, NAMING the stale writer — never a
+    checksum-by-checksum failure hunt."""
     manifest = read_manifest(path)
-    arrays_path = os.path.join(path, ARRAYS_NAME)
+    _validate_pod_tags(path, manifest)
+    by_file: Dict[str, Dict[str, Any]] = {}
+    for key, rec in manifest["arrays"].items():
+        by_file.setdefault(rec.get("file", ARRAYS_NAME), {})[key] = rec
+    fire_path = os.path.join(
+        path, ARRAYS_NAME if ARRAYS_NAME in by_file or not by_file
+        else sorted(by_file)[0])
     if _faults.armed_or_env():
-        _faults.fire("ckpt.read_arrays", path=arrays_path,
+        _faults.fire("ckpt.read_arrays", path=fire_path,
                      default_kind="bitflip")
     raw: Dict[str, np.ndarray] = {}
     try:
-        with np.load(arrays_path, allow_pickle=False) as zf:
-            names = set(zf.files)
-            want = set(manifest["arrays"])
-            if names != want:
-                raise CheckpointCorrupt(
-                    "%s: array set mismatch (missing %s, unexpected %s)"
-                    % (path, sorted(want - names), sorted(names - want)))
-            for key, rec in manifest["arrays"].items():
-                arr = zf[key]            # zip-level CRC also checked here
-                if list(arr.shape) != list(rec["shape"]) or \
-                        str(arr.dtype) != rec["dtype"]:
+        for fname in sorted(by_file):
+            want_recs = by_file[fname]
+            with np.load(os.path.join(path, fname),
+                         allow_pickle=False) as zf:
+                names = set(zf.files)
+                want = set(want_recs)
+                if names != want:
                     raise CheckpointCorrupt(
-                        "%s: %r is %s%s, manifest says %s%s"
-                        % (path, key, arr.dtype, arr.shape,
-                           rec["dtype"], tuple(rec["shape"])))
-                if verify and _crc32(arr) != rec["crc32"]:
-                    raise CheckpointCorrupt(
-                        "%s: checksum mismatch on %r" % (path, key))
-                raw[key] = arr
+                        "%s: array set mismatch in %s (missing %s, "
+                        "unexpected %s)"
+                        % (path, fname, sorted(want - names),
+                           sorted(names - want)))
+                for key, rec in want_recs.items():
+                    arr = zf[key]    # zip-level CRC also checked here
+                    if list(arr.shape) != list(rec["shape"]) or \
+                            str(arr.dtype) != rec["dtype"]:
+                        raise CheckpointCorrupt(
+                            "%s: %r is %s%s, manifest says %s%s"
+                            % (path, key, arr.dtype, arr.shape,
+                               rec["dtype"], tuple(rec["shape"])))
+                    if verify and _crc32(arr) != rec["crc32"]:
+                        raise CheckpointCorrupt(
+                            "%s: checksum mismatch on %r" % (path, key))
+                    raw[key] = arr
     except CheckpointError:
         raise
     except Exception as exc:                               # noqa: BLE001
@@ -494,12 +855,30 @@ def collect_garbage(base: str, keep_last: int,
     behind) but are logged for the operator."""
     from .. import profiler as _profiler
     removed = 0
-    # reap tmp residues of writers that are gone (kill -9 mid-write)
+    # reap tmp residues of writers that are gone (kill -9 mid-write);
+    # pod staging dirs have no live pid to key on — reap them when their
+    # step finalized, their generation is over, or they aged out
+    cur_gen = os.environ.get("MXNET_TPU_POD_GEN")
     try:
         for name in os.listdir(base):
             m = _TMP_RE.match(name)
             if m and not _pid_alive(int(m.group(1))):
                 shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+                continue
+            pm = _POD_TMP_RE.match(name)
+            if pm is None:
+                continue
+            p = os.path.join(base, name)
+            finalized = os.path.isdir(
+                os.path.join(base, checkpoint_dir_name(int(pm.group(1)))))
+            stale_gen = cur_gen is not None and pm.group(2) != cur_gen
+            try:
+                aged = (_time.time() - os.path.getmtime(p)
+                        ) > _POD_TMP_MAX_AGE
+            except OSError:
+                aged = False
+            if finalized or stale_gen or aged:
+                shutil.rmtree(p, ignore_errors=True)
     except OSError:
         pass
     if keep_last is None or keep_last <= 0:

@@ -367,8 +367,21 @@ class CheckpointManager(object):
         from .. import profiler as _profiler
         _profiler.incr_counter("ckpt_sigterm")
         self.wait()
-        self.save_module(module, epoch=epoch, batches_done=batches_done,
-                         metric=metric, sync=True)
+        try:
+            self.save_module(module, epoch=epoch,
+                             batches_done=batches_done,
+                             metric=metric, sync=True)
+        except _format.CheckpointPodError as exc:
+            # a pod being drained because a PEER died cannot land a
+            # collective final save (the commit barrier has a dead
+            # member) — that is expected, not fatal: the newest COMPLETE
+            # checkpoint is the resume point, and the exit-143 protocol
+            # must still run so the supervisor resumes the surviving
+            # world instead of misreading a crash
+            _profiler.incr_counter("ckpt_preempt_save_failed")
+            log.error("preemption save could not complete as a pod unit "
+                      "(%s); resuming from the newest complete "
+                      "checkpoint instead", exc)
         # raise_errors=False: a STALE async-write failure from earlier in
         # the run (already logged + counted) must not abort the exit-143
         # protocol now that the final synchronous save has landed —
@@ -517,14 +530,19 @@ class CheckpointManager(object):
                         attempt + 1, retries + 1, delay)
                     if delay:
                         time.sleep(delay)
+        rank, _world = _format.pod_info()
         try:
-            nbytes = os.path.getsize(
-                os.path.join(path, _format.ARRAYS_NAME))
+            arrays_name = _format.ARRAYS_NAME if _world == 1 \
+                else "arrays-p%d.npz" % rank
+            nbytes = os.path.getsize(os.path.join(path, arrays_name))
         except OSError:
             nbytes = 0
-        _format.collect_garbage(self.config.directory,
-                                self.config.resolved_keep_last(),
-                                self.config.keep_every)
+        if rank == 0:
+            # in a pod, retention is rank 0's job — concurrent per-host
+            # GC of one shared directory would race the validity probes
+            _format.collect_garbage(self.config.directory,
+                                    self.config.resolved_keep_last(),
+                                    self.config.keep_every)
         write_us = int((time.perf_counter() - t0) * 1e6)
         _profiler.incr_counter("ckpt_saved")
         _profiler.incr_counter("ckpt_bytes", nbytes)

@@ -180,6 +180,37 @@ register("MXNET_TPU_FAULTS", str, "",
          "docs/architecture/elastic.md). Parsed once at import by "
          "mxnet_tpu.faults; zero-cost when empty. NEVER set in "
          "production")
+register("MXNET_TPU_DIST_TIMEOUT", float, 120.0,
+         "pod bootstrap: seconds each process waits for the whole pod to "
+         "assemble (the roll-call deadline AND jax.distributed's "
+         "initialization_timeout). A missing peer fails the bootstrap "
+         "with an error naming the absent rank — never a hang")
+register("MXNET_TPU_DIST_RETRIES", int, 1,
+         "pod bootstrap: re-attempts of the distributed rendezvous after "
+         "a timeout (a slow-starting peer gets one more window) before "
+         "the error propagates; 0 = fail on the first timeout")
+register("MXNET_TPU_HEARTBEAT_PERIOD", float, 5.0,
+         "liveness heartbeat publish period in seconds "
+         "(dist.heartbeat_start; the staleness deadline is "
+         "MXNET_KVSTORE_HEARTBEAT_STALE_SECS on the READER's clock)")
+register("MXNET_TPU_ELASTIC_STALL_SECS", float, 0.0,
+         "coordinated pod: local stall watchdog — when > 0 and the "
+         "training child's progress file stops advancing for this many "
+         "seconds, the coordinator requests a POD-WIDE restart (drain + "
+         "re-rendezvous; bulk-synchronous training stalls symmetrically, "
+         "so one host's wedged child stalls every host — restarting the "
+         "pod, not evicting a host, is the only sound response when "
+         "every supervisor is still alive). 0 = disabled (long compiles "
+         "and first-batch warmup must not trip it)")
+register("MXNET_TPU_ELASTIC_DRAIN_GRACE", float, 20.0,
+         "coordinated pod drain: seconds between the SIGTERM preemption "
+         "notice and the SIGKILL escalation for a child wedged in a "
+         "collective whose peer died")
+register("MXNET_TPU_CKPT_POD_TIMEOUT", float, 120.0,
+         "process-local checkpoint: seconds rank 0 waits for every "
+         "host's shard record before the manifest commit (and peers "
+         "wait for the commit) — a host dying mid-save aborts the save "
+         "as a unit instead of committing a partial checkpoint")
 register("MXNET_TPU_ELASTIC_MAX_RESTARTS", int, 10,
          "mx.elastic supervisor: restarts allowed before giving up and "
          "returning the child's exit status (exit 143 and crashes both "

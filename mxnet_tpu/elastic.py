@@ -32,6 +32,37 @@ CLI::
     python -m mxnet_tpu.elastic [--max-restarts N] [--backoff S]
         [--world-schedule 8,4,2] -- python train.py --my-args
 
+Multi-host pod mode (ISSUE 11)::
+
+    tools/launch.py -n N --coordinated -- python train.py ...
+    # == every host runs: python -m mxnet_tpu.elastic --coordinated -- ...
+
+Each host runs ONE :class:`PodCoordinator` (rank/world from the same
+DMLC_* env the launcher sets). The coordinators form the pod's control
+plane over the ``jax.distributed`` coordination service — a
+coordination CLIENT only; the no-jax-backend discipline above still
+holds — and publish liveness heartbeats (``dist.heartbeat_start``): a
+host that dies (SIGKILL) or freezes whole (SIGSTOP — a stuck machine)
+stops beating and is caught by the
+``MXNET_KVSTORE_HEARTBEAT_STALE_SECS`` deadline. On a death the
+survivors DRAIN (SIGTERM the child, escalate to SIGKILL after
+``MXNET_TPU_ELASTIC_DRAIN_GRACE``), re-rendezvous at the surviving
+world size (generation bump; the leader — the lowest live rank —
+publishes membership + a fresh data-plane coordinator port), and
+relaunch: the children resume from the newest COMPLETE checkpoint,
+resharding onto the new world. A training CHILD failing with its
+supervisor alive (crash, preemption, or — with the opt-in
+``MXNET_TPU_ELASTIC_STALL_SECS`` watchdog — a wedged child) triggers a
+POD-WIDE restart at the unchanged membership instead: bulk-synchronous
+SPMD cannot restart one rank alone, and a child-level stall is
+symmetric across the pod (every peer blocks in the same collective),
+so eviction would be wrong. Counters: ``elastic_dead_host``,
+``elastic_reshard``, ``elastic_restart``, ``elastic_stall``; gauge
+``elastic_world``. Rank 0 hosts the control plane (like the
+reference's ps-lite scheduler): rank 0's host dying ends the pod — the
+cluster manager restarts the whole job, which then resumes from
+checkpoints.
+
 Environment exported to every attempt:
 
 * ``MXNET_TPU_ELASTIC_ATTEMPT`` — 0-based attempt index (the training
@@ -59,7 +90,8 @@ import sys
 import time
 from typing import Callable, List, Optional, Sequence
 
-__all__ = ["Supervisor", "supervise", "resume_dir", "probe_world", "main"]
+__all__ = ["Supervisor", "PodCoordinator", "supervise", "resume_dir",
+           "probe_world", "main"]
 
 log = logging.getLogger(__name__)
 
@@ -295,6 +327,438 @@ class Supervisor(object):
                 restore_sig()
 
 
+# exit status of a coordinator that judged its OWN host dead (wedged
+# child): the host cannot trust itself, so it leaves the pod and lets
+# the cluster manager replace the machine (EX_TEMPFAIL)
+SELF_DEAD_RC = 75
+
+
+class PodCoordinator(object):
+    """Per-host pod supervisor (``--coordinated``; module docstring).
+
+    One coordinator runs on every host. Control plane: the
+    ``jax.distributed`` coordination service on the DMLC coordinator
+    address (a TCP client — no jax backend is ever initialized in this
+    process). Liveness: plain heartbeats that freeze exactly when this
+    process does. A dead or frozen host triggers pod-wide drain →
+    rendezvous at the surviving world → relaunch, with the children
+    resuming from the newest complete checkpoint (reshard-on-load); a
+    child-level failure triggers a pod-wide restart at the unchanged
+    membership.
+    """
+
+    def __init__(self, argv: Sequence[str],
+                 max_restarts: Optional[int] = None,
+                 heartbeat_period: Optional[float] = None,
+                 stale_after: Optional[float] = None,
+                 stall_after: Optional[float] = None,
+                 drain_grace: Optional[float] = None,
+                 rendezvous_window: Optional[float] = None,
+                 env: Optional[dict] = None,
+                 advertise_host: Optional[str] = None):
+        from . import config as _config
+        from .parallel import dist as _dist
+        argv = list(argv)
+        if argv and argv[0].endswith(".py"):
+            argv.insert(0, sys.executable)
+        if not argv:
+            raise ValueError("pod coordinator needs a child command")
+        self.argv = argv
+        cluster = _dist.cluster_env()
+        if cluster is None:
+            raise RuntimeError(
+                "--coordinated needs the launcher env: run every host "
+                "through tools/launch.py -n N --coordinated (sets "
+                "DMLC_PS_ROOT_URI/PORT, DMLC_NUM_WORKER, DMLC_WORKER_ID)")
+        self.rank = cluster["rank"]
+        self.world = cluster["num_workers"]
+        self.coordinator = cluster["coordinator"]
+        self.max_restarts = int(
+            _config.get("MXNET_TPU_ELASTIC_MAX_RESTARTS")
+            if max_restarts is None else max_restarts)
+        self.heartbeat_period = float(
+            _config.get("MXNET_TPU_HEARTBEAT_PERIOD")
+            if heartbeat_period is None else heartbeat_period)
+        self.stale_after = float(
+            _config.get("MXNET_KVSTORE_HEARTBEAT_STALE_SECS")
+            if stale_after is None else stale_after)
+        self.stall_after = float(
+            _config.get("MXNET_TPU_ELASTIC_STALL_SECS")
+            if stall_after is None else stall_after)
+        self.drain_grace = float(
+            _config.get("MXNET_TPU_ELASTIC_DRAIN_GRACE")
+            if drain_grace is None else drain_grace)
+        self.rendezvous_window = float(
+            max(2.0 * self.stale_after, 10.0)
+            if rendezvous_window is None else rendezvous_window)
+        self.bootstrap_timeout = float(_config.get("MXNET_TPU_DIST_TIMEOUT"))
+        self.env = dict(env) if env is not None else None
+        if advertise_host is None:
+            advertise_host = os.environ.get("MXNET_TPU_POD_HOST")
+        if advertise_host is None:
+            if self.rank == 0:
+                advertise_host = self.coordinator.rsplit(":", 1)[0]
+            else:
+                import socket
+                advertise_host = socket.gethostname()
+        self.advertise = advertise_host
+        self.restarts = 0
+        self.reshards = 0
+        self.dead_hosts = 0
+        self._child: Optional[subprocess.Popen] = None
+        self._terminated = False
+        self._progress_path: Optional[str] = None
+        self._workdir: Optional[str] = None
+        self._gen = 0
+
+    # ------------------------------------------------------------ liveness
+    def _dead_peers(self, members) -> List[int]:
+        from .parallel import dist as _dist
+        dead = _dist.dead_ranks(stale_after=self.stale_after,
+                                timeout_ms=1000)
+        return [r for r in dead if r in members]
+
+    # ---------------------------------------------------------- rendezvous
+    def _rendezvous(self, gen: int) -> Optional[dict]:
+        """Agree on generation ``gen``'s membership. Every live
+        coordinator publishes a join key; the leader (lowest live rank)
+        collects joins within the rendezvous window and publishes the
+        member list + a fresh data-plane coordinator port; followers
+        wait for that record (bounded). Returns the record, or None when
+        this rank was judged dead and evicted."""
+        import json
+        from .parallel import dist as _dist
+        _dist.kv_set("mxpod/g%d/join/%d" % (gen, self.rank),
+                     json.dumps({"host": self.advertise}))
+        dead = set()
+        if gen > 0:
+            dead = set(_dist.dead_ranks(stale_after=self.stale_after,
+                                        timeout_ms=1000))
+            dead.discard(self.rank)   # we are here, deciding to continue
+        leader = min(r for r in range(self.world) if r not in dead)
+        key = "mxpod/g%d/members" % gen
+        if leader == self.rank:
+            members = []
+            deadline = time.monotonic() + (
+                self.bootstrap_timeout if gen == 0
+                else self.rendezvous_window)
+            for r in range(self.world):
+                if r in dead:
+                    continue
+                left_ms = max(1, int((deadline - time.monotonic()) * 1000))
+                raw = _dist.kv_get("mxpod/g%d/join/%d" % (gen, r), left_ms)
+                if raw is not None:
+                    members.append(r)
+                elif gen == 0:
+                    raise RuntimeError(
+                        "pod rendezvous: rank %d of %d never joined "
+                        "generation 0 within %.0fs — check that every "
+                        "host launched its coordinator"
+                        % (r, self.world, self.bootstrap_timeout))
+                else:
+                    log.warning("pod: rank %d missed the generation-%d "
+                                "rendezvous window; continuing without "
+                                "it", r, gen)
+            rec = {"gen": gen, "ranks": members, "leader": self.rank,
+                   "coordinator": "%s:%d" % (self.advertise,
+                                             _dist.free_port())}
+            _dist.kv_set(key, json.dumps(rec))
+        else:
+            # a follower must outwait the leader's WORST case: the full
+            # collection window plus the bootstrap allowance (a follower
+            # timing out on the same clock as a still-collecting leader
+            # would drop a healthy host out of a recoverable pod)
+            wait = self.bootstrap_timeout + self.rendezvous_window
+            raw = _dist.kv_get(key, int(wait * 1000))
+            if raw is None:
+                raise RuntimeError(
+                    "pod rendezvous: the leader never published "
+                    "generation-%d membership within %.0fs (leader host "
+                    "dead? rank 0's host carries the control plane)"
+                    % (gen, wait))
+            rec = json.loads(raw)
+        if self.rank not in rec["ranks"]:
+            return None                           # judged dead: evicted
+        return rec
+
+    # --------------------------------------------------------------- child
+    def _child_env(self, gen: int, rec: dict) -> dict:
+        env = dict(self.env if self.env is not None else os.environ)
+        members = rec["ranks"]
+        uri, _, port = rec["coordinator"].rpartition(":")
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": uri,
+            "DMLC_PS_ROOT_PORT": port,
+            "DMLC_NUM_WORKER": str(len(members)),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(members.index(self.rank)),
+            "MXNET_TPU_POD_GEN": str(gen),
+            "MXNET_TPU_ELASTIC_COORDINATED": "1",
+            "MXNET_TPU_ELASTIC_ATTEMPT": str(gen),
+            "MXNET_TPU_ELASTIC_PROGRESS_FILE": self._progress_path,
+        })
+        if gen > 0:
+            env["MXNET_TPU_ELASTIC_RESUMED"] = "1"
+        return env
+
+    def _drain_child(self) -> None:
+        """Pod drain: preemption-notice SIGTERM first (the child lands a
+        best-effort final save and exits 143), SIGKILL after the grace —
+        a child wedged inside a collective whose peer died cannot
+        observe the notice."""
+        child = self._child
+        if child is None or child.poll() is not None:
+            return
+        try:
+            child.send_signal(signal.SIGTERM)
+        except OSError:
+            return
+        try:
+            child.wait(timeout=self.drain_grace)
+        except subprocess.TimeoutExpired:
+            log.warning("pod drain: child ignored SIGTERM for %.0fs "
+                        "(wedged collective?); escalating to SIGKILL",
+                        self.drain_grace)
+            try:
+                child.kill()
+            except OSError:
+                pass
+            child.wait()
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> int:
+        import tempfile
+        from . import profiler as _profiler
+        from .parallel import dist as _dist
+        _dist.initialize(coordinator_address=self.coordinator,
+                         num_processes=self.world, process_id=self.rank)
+        # plain liveness beat: it freezes exactly when this PROCESS does
+        # (killed, or SIGSTOPped like a stuck host) — which is the one
+        # signal that justifies EVICTING a host. A wedged CHILD with a
+        # live supervisor is deliberately not an eviction signal:
+        # bulk-synchronous training stalls symmetrically (every peer
+        # blocks in the same collective), so child-progress coupling
+        # would make every host judge itself dead at once. That case is
+        # the stall watchdog's (pod-wide restart, _monitor).
+        _dist.heartbeat_start(period=self.heartbeat_period)
+        self._workdir = tempfile.mkdtemp(prefix="mxpod_r%d_" % self.rank)
+        restore_sig = self._install_forwarder()
+        gen = 0
+        prev_world: Optional[int] = None
+        try:
+            while True:
+                if self._terminated:
+                    log.warning("pod: coordinator was SIGTERMed between "
+                                "generations; not restarting")
+                    return 143
+                if gen > 0:
+                    # let liveness settle before deciding membership: a
+                    # freshly-dead host's beat counter needs one full
+                    # staleness window of non-advancement before
+                    # dead_ranks can call it (otherwise a rendezvous
+                    # right after a crash re-admits the corpse and the
+                    # next generation bootstraps against a ghost)
+                    self._settle()
+                if self._terminated:
+                    # SIGTERM during the settle window: leave BEFORE
+                    # joining the rendezvous — a join we then abandon
+                    # would put a ghost in the membership and stall the
+                    # survivors' data-plane bootstrap for a full timeout
+                    log.warning("pod: coordinator was SIGTERMed while "
+                                "settling; not joining generation %d",
+                                gen)
+                    return 143
+                self._progress_path = os.path.join(
+                    self._workdir, "progress-g%d" % gen)
+                rec = self._rendezvous(gen)
+                if rec is None:
+                    log.error("pod: this host (rank %d) was judged dead "
+                              "and evicted from generation %d; exiting "
+                              "%d for the cluster manager",
+                              self.rank, gen, SELF_DEAD_RC)
+                    _dist.heartbeat_stop()
+                    return SELF_DEAD_RC
+                members = rec["ranks"]
+                world = len(members)
+                _profiler.set_gauge("elastic_world", world)
+                if prev_world is not None and world != prev_world:
+                    self.reshards += 1
+                    _profiler.incr_counter("elastic_reshard")
+                    log.warning("pod: world size %d -> %d; children "
+                                "reshard-on-load", prev_world, world)
+                prev_world = world
+                env = self._child_env(gen, rec)
+                if self._terminated:
+                    # the SIGTERM landed during settle/rendezvous (no
+                    # child alive to forward to): do not spawn a fresh
+                    # child just to hard-kill it
+                    log.warning("pod: coordinator was SIGTERMed during "
+                                "rendezvous; not starting generation %d",
+                                gen)
+                    return 143
+                log.info("pod generation %d (rank %d/%d, world %d): %s",
+                         gen, self.rank, self.world, world,
+                         " ".join(self.argv))
+                self._gen = gen
+                self._child = subprocess.Popen(self.argv, env=env)
+                outcome = self._monitor(members)
+                self._child = None
+                if outcome == "done":
+                    return 0
+                if outcome == "terminated":
+                    return 143
+                if outcome == "self-dead":
+                    _dist.heartbeat_stop()
+                    return SELF_DEAD_RC
+                if outcome == "control-plane-lost":
+                    _dist.heartbeat_stop()
+                    return 1
+                # "drained" (peer death) and a child crash/preemption
+                # both consume restart budget: a flapping pod must not
+                # relaunch forever
+                if self.restarts >= self.max_restarts:
+                    rc = outcome if isinstance(outcome, int) else 1
+                    log.error("pod: restart budget exhausted (%d); "
+                              "giving up with rc=%d",
+                              self.max_restarts, rc)
+                    return rc
+                self.restarts += 1
+                _profiler.incr_counter("elastic_restart")
+                gen += 1
+        finally:
+            _dist.heartbeat_stop()
+            if restore_sig is not None:
+                restore_sig()
+
+    def _settle(self) -> None:
+        """One full staleness window of liveness observation before a
+        rendezvous decides membership."""
+        from .parallel import dist as _dist
+        _dist.dead_ranks(stale_after=self.stale_after,
+                         timeout_ms=1000)          # prime observations
+        deadline = time.monotonic() + self.stale_after \
+            + 2.0 * self.heartbeat_period
+        while not self._terminated:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(0.25, left))
+
+    def _monitor(self, members):
+        """Watch the child AND the pod. Returns ``"done"`` (child exit
+        0), ``"terminated"`` (supervisor SIGTERMed), ``"self-dead"``
+        (our own heartbeat went stale — wedged child), ``"drained"`` (a
+        peer died/wedged or requested a pod-wide restart; child drained,
+        rendezvous next generation), or the child's nonzero exit code
+        (crash/preemption — published as a pod-wide restart request:
+        SPMD training cannot restart one rank alone, every host must
+        drain and re-enter together)."""
+        import json
+        from . import profiler as _profiler
+        from .parallel import dist as _dist
+        _dist.reset_liveness()
+        gen = self._gen
+        restart_key = "mxpod/g%d/restart" % gen
+        poll = max(0.2, min(1.0, self.stale_after / 4.0))
+        child = self._child
+        while True:
+            rc = child.poll()
+            if rc == 0:
+                return "done"
+            if self._terminated:
+                # SIGTERM aimed at the coordinator: deliver the
+                # preemption notice OURSELVES (the forwarder only signals
+                # whatever child existed at signal time — this child may
+                # have been spawned just after), then wait out the final
+                # save, escalating after the grace. No restart.
+                try:
+                    child.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+                try:
+                    child.wait(timeout=self.drain_grace)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    child.wait()
+                return "terminated"
+            if rc is not None:
+                if rc == 143:
+                    _profiler.incr_counter("elastic_preempt")
+                    log.warning("pod: child preempted (exit 143)")
+                else:
+                    _profiler.incr_counter("elastic_crash")
+                    log.warning("pod: child died (%s)",
+                                "signal %d" % -rc if rc < 0
+                                else "exit %d" % rc)
+                _dist.kv_set(restart_key,
+                             json.dumps({"rank": self.rank, "rc": rc}))
+                return rc if rc != 0 else 1
+            dead = self._dead_peers(members)
+            if len(dead) >= len(members):
+                # EVERY rank unreadable, ourselves included, means the
+                # coordination service itself is gone — rank 0's host
+                # died (the documented control-plane limit). That is a
+                # JOB failure for the cluster manager to restart, not
+                # evidence that this machine is broken: do NOT exit
+                # SELF_DEAD_RC, which asks for the machine's replacement
+                log.error("pod: the control plane is unreachable (rank "
+                          "0's host dead?); draining and ending the pod")
+                self._drain_child()
+                return "control-plane-lost"
+            if self.rank in dead:
+                # defensive: our own beat stopped advancing (publisher
+                # thread died, coordinator-side eviction) — the pod has
+                # already written us off; do not fight it
+                log.error("pod: our own heartbeat went stale; draining "
+                          "and leaving the pod")
+                self._drain_child()
+                return "self-dead"
+            dead = [r for r in dead if r != self.rank]
+            if dead:
+                self.dead_hosts += len(dead)
+                _profiler.incr_counter("elastic_dead_host", len(dead))
+                log.warning("pod: host rank(s) %s dead or wedged past "
+                            "the %.0fs deadline; draining for "
+                            "re-rendezvous at the surviving world",
+                            dead, self.stale_after)
+                self._drain_child()
+                return "drained"
+            if _dist.kv_get(restart_key, 50) is not None:
+                log.warning("pod: a peer requested a pod-wide restart "
+                            "of generation %d; draining", gen)
+                self._drain_child()
+                return "drained"
+            if self.stall_after > 0 and self._progress_path:
+                # local stall watchdog (opt-in): our child stopped
+                # advancing but every supervisor is alive — one host's
+                # wedged child stalls the whole bulk-synchronous pod,
+                # so the sound response is a POD-WIDE restart, never an
+                # eviction (the stall is symmetric; whoever notices
+                # first requests it for everyone)
+                try:
+                    # wall-clock on BOTH sides: st_mtime is wall-clock,
+                    # so monotonic() cannot be compared against it
+                    stalled = (time.time()  # mx-lint: allow(wall-clock)
+                               - os.stat(self._progress_path).st_mtime
+                               ) > self.stall_after
+                except OSError:
+                    stalled = False      # no batch yet: startup/compile
+                if stalled:
+                    _profiler.incr_counter("elastic_stall")
+                    log.warning("pod: child progress stalled past "
+                                "%.0fs; requesting a pod-wide restart",
+                                self.stall_after)
+                    _dist.kv_set(restart_key, json.dumps(
+                        {"rank": self.rank, "stall": True}))
+                    self._drain_child()
+                    return "drained"
+            time.sleep(poll)
+
+    # the SIGTERM forwarder is identical to the Supervisor's
+    _install_forwarder = Supervisor._install_forwarder
+
+
 def supervise(argv: Sequence[str], **kwargs) -> int:
     """One-call form: build a :class:`Supervisor` and run it."""
     return Supervisor(argv, **kwargs).run()
@@ -324,6 +788,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         default=None,
                         help="test rig: host device count per attempt, "
                              "e.g. 8,4,2 (last entry repeats)")
+    parser.add_argument("--coordinated", action="store_true",
+                        help="multi-host pod mode: run ONE per-host "
+                             "coordinator under tools/launch.py -n N "
+                             "(control-plane heartbeats, pod-wide drain/"
+                             "reshard/resume on host death — see module "
+                             "docstring)")
+    parser.add_argument("--drain-grace", type=float, default=None,
+                        help="coordinated: seconds between the drain "
+                             "SIGTERM and the SIGKILL escalation")
+    parser.add_argument("--stale-after", type=float, default=None,
+                        help="coordinated: heartbeat staleness deadline "
+                             "(default MXNET_KVSTORE_HEARTBEAT_STALE_SECS)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="child command (prefix with -- to separate)")
     args = parser.parse_args(argv)
@@ -334,6 +810,56 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         parser.error("no child command given")
     logging.basicConfig(level=logging.INFO,
                         format="[elastic] %(message)s")
+    if args.coordinated:
+        import json
+        coord = PodCoordinator(command, max_restarts=args.max_restarts,
+                               drain_grace=args.drain_grace,
+                               stale_after=args.stale_after)
+        try:
+            rc = coord.run()
+        except SystemExit as exc:
+            rc = int(exc.code) if isinstance(exc.code, int) else 1
+        except BaseException:                              # noqa: BLE001
+            # an escaping error (e.g. the leader's host died and the
+            # control plane with it) must still reach the HARD exit
+            # below — the normal interpreter path runs jax's atexit
+            # distributed-shutdown barrier, which hangs/aborts over the
+            # dead pod members this mode exists to survive
+            import traceback
+            traceback.print_exc()
+            rc = 1
+        from . import profiler as _profiler
+        # machine-readable exit record: the pod drill (and operators'
+        # log scrapers) assert on these without reaching into the process
+        print("POD-COORDINATOR-EXIT rank=%d rc=%d restarts=%d "
+              "reshards=%d dead_hosts=%d counters=%s"
+              % (coord.rank, rc, coord.restarts, coord.reshards,
+                 coord.dead_hosts,
+                 json.dumps({k: v for k, v in
+                             _profiler.counters().items()
+                             if k.startswith("elastic")},
+                            sort_keys=True)), flush=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # Exit order: rank 0 hosts the coordination service, so it must
+        # leave LAST — a peer whose client outlives the leader aborts
+        # fatally over the closed socket. Non-leaders publish done as
+        # their LAST act before the hard exit (nothing in between that
+        # an abort could interrupt); rank 0 collects with a bounded
+        # per-rank wait (dead hosts never publish; skip them after 5s).
+        try:
+            from .parallel import dist as _dist
+            _dist.kv_set("mxpod/done/%d" % coord.rank, str(rc))
+            if coord.rank == 0:
+                for r in range(1, coord.world):
+                    _dist.kv_get("mxpod/done/%d" % r, 5000)
+        except Exception:                                  # noqa: BLE001
+            pass    # a broken control plane must not mask the exit code
+        # HARD exit: jax's atexit distributed-shutdown barrier would wait
+        # on (and then abort over) pod members that died — the exact
+        # event this mode exists to survive. Nothing is left to clean up:
+        # the child is reaped and the exit record is flushed.
+        os._exit(rc if 0 <= rc < 256 else 1)
     return supervise(command, max_restarts=args.max_restarts,
                      backoff=args.backoff, backoff_max=args.backoff_max,
                      world_schedule=args.world_schedule)

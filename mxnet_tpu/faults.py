@@ -32,6 +32,7 @@ ckpt.before_rename   writer, pre-rename (torn)     sigkill
 ckpt.read_manifest   reader, before manifest open  bitflip
 ckpt.read_arrays     reader, before npz open       bitflip
 fit.batch            fit loop, each batch start    sigterm
+host.die             fit loop, each batch start    hostkill
 serve.submit         InferenceServer.submit        raise
 ===================  ============================  =====================
 
@@ -41,11 +42,17 @@ Failure kinds: ``eio``/``enospc``/``eintr`` raise the matching
 the signal to this process (preemption-notice / hard-kill drills);
 ``bitflip`` flips one byte in the middle of the site's file and returns
 (the subsequent read must *detect* the corruption); ``truncate`` cuts
-the site's file in half and returns.
+the site's file in half and returns; ``hostkill`` SIGKILLs the
+coordinated supervisor (parent) and then this process — the whole host
+vanishes, the pod drill's node-loss model; ``wedge`` stops making
+progress while staying alive (the failure only a heartbeat deadline
+catches).
 
 Every fired fault bumps the ``fault_injected`` profiler counter (plus
-``fault_injected.<site>``) *before* acting, so even a SIGKILL drill
-leaves an attributable trace in a parent-readable counter dump.
+``fault_injected.<site>``) *before* acting, and — when
+``MXNET_TPU_FAULTS_TOUCH=<path>`` names a marker file — appends
+``<site>@<arrival>:<kind>`` to it first, so even a SIGKILL/hostkill
+drill leaves an attributable, parent-readable trace.
 """
 from __future__ import annotations
 
@@ -64,7 +71,7 @@ ENV = "MXNET_TPU_FAULTS"
 LEGACY_ENV = "MXNET_TPU_CKPT_TEST_CRASH"
 
 KINDS = ("eio", "enospc", "eintr", "raise", "sigterm", "sigkill",
-         "bitflip", "truncate")
+         "bitflip", "truncate", "hostkill", "wedge")
 
 # the shipped injection points (docs/architecture/elastic.md catalog).
 # A spec naming a site outside this set is accepted — new sites must be
@@ -73,8 +80,20 @@ KINDS = ("eio", "enospc", "eintr", "raise", "sigterm", "sigkill",
 SITES = frozenset((
     "ckpt.arrays_write", "ckpt.after_arrays", "ckpt.after_manifest",
     "ckpt.before_rename", "ckpt.read_manifest", "ckpt.read_arrays",
-    "fit.batch", "serve.submit",
+    "fit.batch", "serve.submit", "host.die",
 ))
+
+# kinds that model a HOST dying rather than one process failing
+# (multi-host pod drills, docs/architecture/elastic.md):
+#   hostkill — SIGKILL the coordinated supervisor (the parent process,
+#              only when it marked this child MXNET_TPU_ELASTIC_COORDINATED
+#              — never kill an arbitrary parent shell) and then this
+#              process: the whole "host" vanishes without cleanup, the
+#              honest analog of a node loss, deliverable mid-checkpoint-
+#              write via the ckpt.* sites;
+#   wedge    — stop making progress while staying alive (sleep forever):
+#              the silent failure only a heartbeat deadline catches.
+MARKER_ENV = "MXNET_TPU_FAULTS_TOUCH"
 
 _ERRNO = {"eio": errno.EIO, "enospc": errno.ENOSPC, "eintr": errno.EINTR}
 
@@ -252,6 +271,18 @@ def fire(site: str, path: Optional[str] = None,
     from . import profiler as _profiler
     _profiler.incr_counter("fault_injected")
     _profiler.incr_counter("fault_injected.%s" % site)
+    marker = os.environ.get(MARKER_ENV)
+    if marker:
+        # parent-readable trace BEFORE acting: even a hostkill/SIGKILL
+        # drill leaves an attributable record a supervisor or the drill
+        # driver can assert on (O_APPEND: concurrent writers don't tear)
+        try:
+            with open(marker, "a") as f:
+                f.write("%s@%d:%s\n" % (site, count, kind))
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            pass
     if kind in _ERRNO:
         raise OSError(_ERRNO[kind],
                       "injected %s fault at %s" % (kind, site),
@@ -264,6 +295,34 @@ def fire(site: str, path: Optional[str] = None,
     if kind == "sigkill":
         os.kill(os.getpid(), signal.SIGKILL)
         return
+    if kind == "hostkill":
+        # the whole host dies: take the coordinated supervisor down FIRST
+        # (no cleanup, no forwarded signals — exactly what a node loss
+        # looks like to the surviving pod), then this process. Guarded by
+        # the coordinator's env marker so a drill never SIGKILLs an
+        # arbitrary parent (a shell, pytest, an IDE)
+        if os.environ.get("MXNET_TPU_ELASTIC_COORDINATED"):
+            try:
+                os.kill(os.getppid(), signal.SIGKILL)
+            except OSError:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+        return
+    if kind == "wedge":
+        # the silent failure: the whole HOST freezes — alive, responsive
+        # to nothing, making no progress. The coordinated supervisor is
+        # SIGSTOPped (a stopped process is exactly what a stuck host
+        # looks like: its liveness beat freezes mid-count), then this
+        # process spins in sleep. Detectable only by the heartbeat
+        # staleness deadline.
+        import time
+        if os.environ.get("MXNET_TPU_ELASTIC_COORDINATED"):
+            try:
+                os.kill(os.getppid(), signal.SIGSTOP)
+            except OSError:
+                pass
+        while True:
+            time.sleep(3600)
     if kind in ("bitflip", "truncate"):
         if path is None:
             raise FaultInjected(

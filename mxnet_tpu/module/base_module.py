@@ -8,6 +8,7 @@ primitives its subclasses implement.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import List, Optional
 
@@ -428,6 +429,19 @@ class BaseModule(object):
         # observability.md lane map)
         _profiler.register_thread_lane("train")
 
+        # coordinated pod mode: the per-host supervisor couples its
+        # liveness heartbeat to this file — a training process that stops
+        # advancing it (wedged collective, hung iterator) is declared
+        # dead by the pod once the staleness deadline passes
+        progress_path = os.environ.get("MXNET_TPU_ELASTIC_PROGRESS_FILE")
+
+        def _touch_progress(count):
+            try:
+                with open(progress_path, "w") as pf:
+                    pf.write("%d\n" % count)
+            except OSError:
+                pass
+
         completed = False
         if ckpt_mgr is not None and ckpt_mgr.config.save_on_sigterm:
             uninstall_sigterm = ckpt_mgr.install_sigterm()
@@ -471,8 +485,11 @@ class BaseModule(object):
                     if _faults.ARMED:
                         # deterministic preemption/crash drills: the
                         # elastic suite SIGTERMs/SIGKILLs fit at batch K
-                        # (MXNET_TPU_FAULTS=fit.batch@K[:kind])
+                        # (MXNET_TPU_FAULTS=fit.batch@K[:kind]); the pod
+                        # drill kills or wedges the whole HOST here
+                        # (host.die@K[:hostkill|wedge])
                         _faults.fire("fit.batch", default_kind="sigterm")
+                        _faults.fire("host.die", default_kind="hostkill")
                     data_batch = next_data_batch
                     # the batch's flow id threads its trace slices across
                     # lanes (prefetch -> place -> step -> metric); batches
@@ -521,6 +538,8 @@ class BaseModule(object):
                         for callback in _as_list(batch_end_callback):
                             callback(batch_end_params)
                     nbatch += 1
+                    if progress_path:
+                        _touch_progress(nbatch)
                     if ckpt_mgr is not None:
                         if ckpt_every_n and nbatch % ckpt_every_n == 0:
                             # the snapshot must be a step boundary: wait

@@ -603,11 +603,33 @@ class Module(BaseModule):
                     "index_update_count": {
                         str(k): int(v) for k, v in
                         self._optimizer._index_update_count.items()}}
+            elif self._update_on_kvstore and self._kvstore is not None \
+                    and getattr(self._kvstore, "_updater_obj",
+                                None) is not None:
+                # SPMD dist kvstore: there is no server process — every
+                # rank holds the SAME updater/optimizer state locally
+                # (set_optimizer constructs it per process), so the
+                # snapshot is as local as the eager-updater case. This is
+                # what lets a multi-host pod checkpoint/resume through
+                # the ordinary fit(checkpoint=..., resume_from=...) path.
+                upd = self._kvstore._updater_obj
+                structure = {
+                    str(idx): tree_encode("upd:%s" % idx, s, tensors,
+                                          grab)
+                    for idx, s in upd.states.items()}
+                step = int(upd.optimizer.num_update)
+                meta["optimizer"] = {
+                    "kind": "kvstore", "structure": structure,
+                    "num_update": step,
+                    "index_update_count": {
+                        str(k): int(v) for k, v in
+                        upd.optimizer._index_update_count.items()}}
             else:
                 raise CheckpointError(
                     "optimizer state lives on the kvstore "
-                    "(update_on_kvstore); mx.checkpoint cannot snapshot "
-                    "it — use save_optimizer_states / the legacy "
+                    "(update_on_kvstore) and the store exposes no local "
+                    "updater; mx.checkpoint cannot snapshot it — use "
+                    "save_optimizer_states / the legacy "
                     "module_checkpoint callback instead")
         meta["step"] = step
 
@@ -623,6 +645,13 @@ class Module(BaseModule):
             meta["mesh"] = axis_sizes(self._mesh)
         meta["world_size"] = int(self._mesh.devices.size) \
             if self._mesh is not None else 1
+        from ..checkpoint.format import pod_info
+        pod_rank, pod_world = pod_info()
+        if pod_world > 1:
+            # multi-host provenance: a resume at a different pod world
+            # is the elastic reshard path (counted at restore)
+            meta["pod"] = {"process_index": pod_rank,
+                           "world_size": pod_world}
 
         # protect every captured device buffer in ONE jitted copy program
         # (a single dispatch instead of ~2 per-op milliseconds per array
@@ -662,13 +691,27 @@ class Module(BaseModule):
             else None
         cur_world = int(self._mesh.devices.size) \
             if self._mesh is not None else 1
-        if saved_world is not None and \
-                (saved_mesh, int(saved_world)) != (cur_mesh, cur_world):
-            _profiler.incr_counter("elastic_reshard")
+        resharded = saved_world is not None and \
+            (saved_mesh, int(saved_world)) != (cur_mesh, cur_world)
+        if resharded:
             self.logger.info(
                 "resume: resharding checkpoint saved on mesh %s "
                 "(world %s) onto mesh %s (world %d)",
                 saved_mesh, saved_world, cur_mesh, cur_world)
+        from ..checkpoint.format import pod_info
+        saved_pod = int((ckpt.meta.get("pod") or {}).get("world_size", 1))
+        cur_pod = pod_info()[1]
+        if saved_pod != cur_pod:
+            # host death / pod growth: the surviving world resumes the
+            # dead world's checkpoint (reassembled from its per-host
+            # index windows)
+            self.logger.info(
+                "resume: checkpoint saved by a %d-host pod restoring "
+                "onto a %d-host pod", saved_pod, cur_pod)
+        if resharded or saved_pod != cur_pod:
+            # ONE reshard event per resume, however many dimensions
+            # (device mesh, pod world) changed at once
+            _profiler.incr_counter("elastic_reshard")
         opt_meta = ckpt.meta.get("optimizer") or {}
         kind = opt_meta.get("kind")
         if kind == "fused":
@@ -724,6 +767,31 @@ class Module(BaseModule):
                 {int(k): int(v) for k, v in
                  opt_meta.get("index_update_count", {}).items()})
             self._fused_num_update = self._optimizer.num_update
+        elif kind == "kvstore":
+            upd = getattr(self._kvstore, "_updater_obj", None) \
+                if self._kvstore is not None else None
+            if upd is None:
+                raise CheckpointCorrupt(
+                    "%s holds kvstore updater state but this module is "
+                    "not bound to a kvstore with a local updater "
+                    "(resume with the same kvstore= as the save)"
+                    % ckpt.path)
+            states = {}
+            for sidx, s in opt_meta["structure"].items():
+                idx = int(sidx) if sidx.lstrip("-").isdigit() else sidx
+                states[idx] = tree_decode(
+                    "upd:%s" % sidx, s, tensors,
+                    lambda x: nd.array(np.asarray(x),
+                                       dtype=np.asarray(x).dtype))
+            upd.states.update(states)
+            upd.optimizer.num_update = int(opt_meta["num_update"])
+            upd.optimizer._index_update_count.update(
+                {int(k): int(v) for k, v in
+                 opt_meta.get("index_update_count", {}).items()})
+            # the kvstore weight replicas need no replay: init_optimizer
+            # already ran kvstore.init with the RESTORED params (fit
+            # restores params before the optimizer), and every rank
+            # restored the same checkpoint
 
         raw = tensors.get("rng:executor_key")
         if raw is not None:

@@ -40,7 +40,8 @@ from ..profiler import (span, spans_enabled, new_flow,            # noqa: F401
                         observe, counter_delta)
 from . import compiles
 from .compiles import scope as compile_scope                      # noqa: F401
-from .prometheus import render_prometheus, parse_prometheus       # noqa: F401
+from .prometheus import (render_prometheus, parse_prometheus,     # noqa: F401
+                         pod_labels)
 from . import mfu
 from .mfu import peak_flops, register_executor                    # noqa: F401
 from .http import MetricsServer, start_metrics_server             # noqa: F401
@@ -49,7 +50,7 @@ __all__ = [
     "span", "spans_enabled", "new_flow", "register_thread_lane",
     "Histogram", "histogram", "observe", "counter_delta",
     "compile_scope", "compiles",
-    "render_prometheus", "parse_prometheus",
+    "render_prometheus", "parse_prometheus", "pod_labels",
     "mfu", "peak_flops", "register_executor",
     "MetricsServer", "start_metrics_server",
     "report",
@@ -90,7 +91,7 @@ def report() -> Dict[str, Any]:
             "p50": h.quantile(0.50),
             "p99": h.quantile(0.99),
         }
-    return {
+    out = {
         "executors": executors,
         "compiles": compiles.snapshot(),
         "counters": {k: v for k, v in _profiler.counters().items()
@@ -99,3 +100,10 @@ def report() -> Dict[str, Any]:
                    if k.startswith("obs_")},
         "histograms": hist,
     }
+    labels = pod_labels()
+    if labels:
+        # multi-host: every host reports under its own identity so
+        # aggregation across the pod is explicit, never a collision
+        out["process"] = {"process_index": int(labels["process_index"]),
+                          "world_size": int(labels["world_size"])}
+    return out

@@ -17,7 +17,7 @@ from typing import Dict, Optional, Tuple
 
 from .. import profiler as _profiler
 
-__all__ = ["render_prometheus", "parse_prometheus"]
+__all__ = ["render_prometheus", "parse_prometheus", "pod_labels"]
 
 _SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -39,20 +39,50 @@ def _fmt(v) -> str:
     return repr(f)
 
 
-def render_prometheus(prefix: str = "mxnet_tpu") -> str:
+def pod_labels() -> Dict[str, str]:
+    """Per-host identity labels when a ``jax.distributed`` pod is active
+    (empty otherwise): every host of a pod scrapes the same metric
+    names, so without these labels federated/aggregated scrapes would
+    COLLIDE — rank 3's ``ckpt_saved_total`` silently overwriting rank
+    0's. A pure state probe (``checkpoint.format.pod_info``)."""
+    from ..checkpoint.format import pod_info
+    rank, world = pod_info()
+    if world <= 1:
+        return {}
+    return {"process_index": str(rank), "world_size": str(world)}
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = ['%s="%s"' % (k, v) for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+def render_prometheus(prefix: str = "mxnet_tpu",
+                      labels: Optional[Dict[str, str]] = None) -> str:
     """One scrape body over every registered counter, gauge and
-    histogram. Metric names are ``<prefix>_<sanitized registry key>``."""
+    histogram. Metric names are ``<prefix>_<sanitized registry key>``.
+
+    ``labels`` are attached to every sample; by default they are the
+    pod identity labels (:func:`pod_labels` — ``process_index`` /
+    ``world_size`` under multi-host, nothing single-process), so
+    per-host telemetry federates instead of colliding. Pass ``{}`` to
+    force bare samples."""
+    if labels is None:
+        labels = pod_labels()
+    lab = _label_str(labels)
     lines = []
     for name, v in sorted(_profiler.counters().items()):
         m = _metric_name(prefix, name)
         if not m.endswith("_total"):    # registry keys like
             m += "_total"               # obs_bind_ms_total keep one suffix
         lines.append("# TYPE %s counter" % m)
-        lines.append("%s %s" % (m, _fmt(v)))
+        lines.append("%s%s %s" % (m, lab, _fmt(v)))
     for name, v in sorted(_profiler.gauges().items()):
         m = _metric_name(prefix, name)
         lines.append("# TYPE %s gauge" % m)
-        lines.append("%s %s" % (m, _fmt(v)))
+        lines.append("%s%s %s" % (m, lab, _fmt(v)))
     for name, h in sorted(_profiler.histograms().items()):
         snap = h.snapshot()
         m = _metric_name(prefix, name)
@@ -61,10 +91,14 @@ def render_prometheus(prefix: str = "mxnet_tpu") -> str:
         for bound, c in zip(snap["bounds"], snap["counts"]):
             cum += c
             if c:
-                lines.append('%s_bucket{le="%.6g"} %d' % (m, bound, cum))
-        lines.append('%s_bucket{le="+Inf"} %d' % (m, snap["count"]))
-        lines.append("%s_sum %s" % (m, _fmt(snap["sum"])))
-        lines.append("%s_count %d" % (m, snap["count"]))
+                lines.append('%s_bucket%s %d'
+                             % (m, _label_str(labels,
+                                              'le="%.6g"' % bound), cum))
+        lines.append('%s_bucket%s %d'
+                     % (m, _label_str(labels, 'le="+Inf"'),
+                        snap["count"]))
+        lines.append("%s_sum%s %s" % (m, lab, _fmt(snap["sum"])))
+        lines.append("%s_count%s %d" % (m, lab, snap["count"]))
     return "\n".join(lines) + "\n"
 
 

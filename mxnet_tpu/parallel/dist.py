@@ -20,11 +20,28 @@ from __future__ import annotations
 
 import os
 from functools import partial
-from typing import Optional
+from typing import Callable, List, Optional
 
 __all__ = ["initialize", "is_initialized", "cluster_env", "rank",
            "num_workers", "allreduce_sum", "broadcast", "barrier",
-           "heartbeat_start", "heartbeat_stop", "num_dead_nodes"]
+           "heartbeat_start", "heartbeat_stop", "num_dead_nodes",
+           "dead_ranks", "reset_liveness", "kv_set", "kv_get",
+           "free_port", "BootstrapTimeout"]
+
+
+def free_port() -> int:
+    """Probe a free TCP port (bind 0, read it back, release). The usual
+    TOCTOU caveat applies — the pod rendezvous publishes the port and
+    rebinds it moments later; ONE shared helper so any future
+    hardening (retry, port ranges) lands everywhere at once.
+    (tools/launch.py keeps a private copy: the launcher is deliberately
+    stdlib-only and runs before the package is importable.)"""
+    import socket
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 _INITIALIZED = False
 _COMM = None          # (mesh, local_device) cache
@@ -58,11 +75,131 @@ def coordination_active() -> bool:
         return False
 
 
-def initialize(coordinator_address=None, num_processes=None, process_id=None):
+class BootstrapTimeout(RuntimeError):
+    """The pod never fully assembled within the bootstrap deadline. The
+    message names the absent rank(s) when the roll-call could tell."""
+
+
+def _rollcall(coordinator_address: str, n: int, process_id: int,
+              deadline: float) -> None:
+    """Pre-rendezvous liveness check on the coordinator port, BEFORE
+    jax.distributed binds it: every rank proves it is up, so a missing
+    peer produces an error NAMING THE ABSENT RANK on every present rank
+    instead of N-1 opaque deadline errors (or, on older stacks, a hang).
+
+    Protocol (rank 0 listens; peers connect-with-retry):
+      peer -> "mxhb <rank>\\n";  rank 0 -> "ok\\n" once ALL ranks arrived,
+      or "missing <r,...>\\n" + close at the deadline.
+    Rank 0 releases the port before returning, then jax.distributed's
+    coordination service binds it; peers' grpc connects retry until the
+    service is up (bounded by initialization_timeout)."""
+    import socket
+    import time
+    host, _, port_s = coordinator_address.rpartition(":")
+    port = int(port_s)
+    t_end = time.monotonic() + deadline
+    if process_id == 0:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        conns = {}
+        try:
+            try:
+                srv.bind(("", port))
+            except OSError:
+                # the port is already owned (a prior half-shutdown
+                # coordination service): skip the roll-call, the jax
+                # rendezvous deadline is the backstop
+                srv.close()
+                return
+            srv.listen(n)
+            while len(conns) < n - 1:
+                left = t_end - time.monotonic()
+                if left <= 0:
+                    break
+                srv.settimeout(min(left, 1.0))
+                try:
+                    conn, _addr = srv.accept()
+                except socket.timeout:
+                    continue
+                try:
+                    conn.settimeout(min(max(left, 0.1), 5.0))
+                    line = conn.makefile("r").readline().strip()
+                    if line.startswith("mxhb "):
+                        conns[int(line.split()[1])] = conn
+                    else:
+                        conn.close()
+                except (OSError, ValueError, IndexError):
+                    conn.close()
+            missing = sorted(set(range(1, n)) - set(conns))
+            reply = b"ok\n" if not missing else \
+                ("missing %s\n" % ",".join(map(str, missing))).encode()
+            for conn in conns.values():
+                try:
+                    conn.sendall(reply)
+                except OSError:
+                    pass
+            if missing:
+                raise BootstrapTimeout(
+                    "pod bootstrap timed out after %.0fs: rank(s) %s of "
+                    "world %d never connected to the coordinator (%s) — "
+                    "check that every host launched its worker"
+                    % (deadline, ",".join(map(str, missing)), n,
+                       coordinator_address))
+        finally:
+            for conn in conns.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            srv.close()
+        return
+    # peers: connect with retry until the deadline
+    while True:
+        left = t_end - time.monotonic()
+        if left <= 0:
+            raise BootstrapTimeout(
+                "pod bootstrap timed out after %.0fs: rank %d could not "
+                "reach the coordinator (rank 0) at %s — is it up?"
+                % (deadline, process_id, coordinator_address))
+        try:
+            conn = socket.create_connection((host or "127.0.0.1", port),
+                                            timeout=min(left, 2.0))
+        except OSError:
+            time.sleep(min(left, 0.2))
+            continue
+        try:
+            conn.settimeout(max(t_end - time.monotonic(), 0.1))
+            conn.sendall(("mxhb %d\n" % process_id).encode())
+            line = conn.makefile("r").readline().strip()
+        except OSError:
+            line = ""
+        finally:
+            conn.close()
+        if line.startswith("missing"):
+            raise BootstrapTimeout(
+                "pod bootstrap failed: coordinator reports rank(s) %s of "
+                "world %d never connected" % (line.split(None, 1)[1], n))
+        # "ok" -> proceed; anything else (EOF, grpc noise) means rank 0 is
+        # already past roll-call and the coordination service owns the
+        # port — jax.distributed.initialize below is the backstop
+        return
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               timeout: Optional[float] = None,
+               retries: Optional[int] = None,
+               rollcall: bool = True):
     """Join the cluster (idempotent). Arguments default to the DMLC_* env.
 
     Must run before any backend is initialized in this process — the global
     device view and the gloo/DCN collectives are fixed at backend creation.
+
+    Bounded bootstrap: the rendezvous can never hang the pod forever — a
+    roll-call on the coordinator port first proves every rank is up
+    (failing with :class:`BootstrapTimeout` naming the absent rank), and
+    ``jax.distributed.initialize`` itself runs under the same
+    ``MXNET_TPU_DIST_TIMEOUT`` deadline with ``MXNET_TPU_DIST_RETRIES``
+    bounded re-attempts for slow-but-alive peers.
     """
     global _INITIALIZED
     if _INITIALIZED:
@@ -84,6 +221,11 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
             "distributed init needs a coordinator: run under tools/launch.py "
             "(sets DMLC_PS_ROOT_URI/PORT, DMLC_NUM_WORKER, DMLC_WORKER_ID) "
             "or pass coordinator_address/num_processes/process_id")
+    from .. import config as _config
+    if timeout is None:
+        timeout = float(_config.get("MXNET_TPU_DIST_TIMEOUT"))
+    if retries is None:
+        retries = max(0, int(_config.get("MXNET_TPU_DIST_RETRIES")))
     import jax
     from jax._src import xla_bridge
     if xla_bridge.backends_are_initialized():
@@ -96,10 +238,43 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
-    _INITIALIZED = True
+    n = num_processes or 1
+    last_exc: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            # the roll-call is INSIDE the retried window: "a
+            # slow-starting peer gets one more window" must cover the
+            # stage a slow peer actually fails at
+            if rollcall and n > 1:
+                _rollcall(coordinator_address, n, process_id or 0,
+                          timeout)
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id,
+                    initialization_timeout=max(1, int(timeout)))
+            except TypeError:     # older jaxlib: no timeout kwarg
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id)
+            _INITIALIZED = True
+            return
+        except Exception as exc:                           # noqa: BLE001
+            last_exc = exc
+            try:
+                jax.distributed.shutdown()
+            except Exception:                              # noqa: BLE001
+                pass
+            if attempt < retries:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "distributed rendezvous attempt %d/%d failed (%s); "
+                    "retrying", attempt + 1, retries + 1, exc)
+    raise BootstrapTimeout(
+        "distributed rendezvous failed after %d attempt(s) x %.0fs "
+        "(rank %s of %s via %s): %s — a peer is down or unreachable"
+        % (retries + 1, timeout, process_id, num_processes,
+           coordinator_address, last_exc)) from last_exc
 
 
 def rank() -> int:
@@ -237,14 +412,29 @@ _hb_thread = None
 _hb_seen = {}
 
 
-def heartbeat_start(period: float = 5.0) -> bool:
+def heartbeat_start(period: Optional[float] = None,
+                    progress_fn: Optional[Callable[[], object]] = None
+                    ) -> bool:
     """Publish this worker's liveness to the coordinator's key-value store
     every ``period`` seconds (reference: ps-lite worker heartbeats to the
     scheduler, feeding kvstore.h:287 get_num_dead_node). The payload is a
     monotonically increasing beat COUNTER, not a wall-clock timestamp —
     staleness is judged on the reader's own clock, so cross-host clock
     skew cannot fake deaths. Idempotent; returns False when no
-    coordination client exists (single process)."""
+    coordination client exists (single process).
+
+    ``period`` defaults to the ``MXNET_TPU_HEARTBEAT_PERIOD`` knob.
+
+    With ``progress_fn``, the beat is PROGRESS-COUPLED: the counter only
+    advances when ``progress_fn()`` returns a different token than the
+    last tick — the hook for tying a worker's liveness to actual work
+    progress (a file mtime, a step counter). A publisher that stops
+    progressing stops advancing, and peers' :func:`num_dead_nodes`
+    counts it dead once the staleness window passes. NB: couple with
+    care in bulk-synchronous pods — one wedged member stalls EVERY
+    member's progress, so progress-coupled beats there make the whole
+    pod look dead at once (the pod coordinator publishes a plain beat
+    for exactly this reason)."""
     global _hb_started, _hb_stop, _hb_thread
     import logging
     import threading
@@ -253,6 +443,9 @@ def heartbeat_start(period: float = 5.0) -> bool:
         return False
     if _hb_started:
         return True
+    if period is None:
+        from .. import config as _config
+        period = float(_config.get("MXNET_TPU_HEARTBEAT_PERIOD"))
     _hb_started = True
     _hb_stop = threading.Event()
 
@@ -262,8 +455,18 @@ def heartbeat_start(period: float = 5.0) -> bool:
     def beat():
         n = 0
         warned = False
+        last_token = object()       # sentinel: first tick always beats
         while not stop.is_set():
-            n += 1
+            if progress_fn is None:
+                n += 1
+            else:
+                try:
+                    token = progress_fn()
+                except Exception:                          # noqa: BLE001
+                    token = last_token     # unreadable progress = stalled
+                if token != last_token or n == 0:
+                    last_token = token
+                    n += 1
             try:
                 try:
                     client.key_value_set(me, str(n), allow_overwrite=True)
@@ -301,27 +504,78 @@ def heartbeat_stop(timeout: float = 2.0):
     _hb_started, _hb_stop, _hb_thread = False, None, None
 
 
-def num_dead_nodes(stale_after: float = 20.0, timeout_ms: int = 1000) -> int:
-    """Count workers whose heartbeat is missing, or whose beat counter has
-    not advanced for ``stale_after`` seconds of the CALLER's clock (two
+def dead_ranks(stale_after: float = 20.0, timeout_ms: int = 1000
+               ) -> List[int]:
+    """Ranks whose heartbeat is missing, or whose beat counter has not
+    advanced for ``stale_after`` seconds of the CALLER's clock (two
     observations are needed to declare staleness, so a first call never
-    false-positives on a slow-but-alive worker)."""
+    false-positives on a slow-but-alive worker). The pod coordinator
+    keys membership decisions on this list; :func:`num_dead_nodes` is
+    its count."""
     import time
     client = _client()
     if client is None:
-        return 0
-    dead = 0
+        return []
+    dead: List[int] = []
     now = time.monotonic()
     for r in range(num_workers()):
         try:
             counter = int(client.blocking_key_value_get(
                 "mxnet_hb/%d" % r, timeout_ms))
         except Exception:
-            dead += 1               # never heartbeated within the timeout
+            dead.append(r)          # never heartbeated within the timeout
             continue
         prev = _hb_seen.get(r)
         if prev is None or prev[0] != counter:
             _hb_seen[r] = (counter, now)
         elif now - prev[1] > stale_after:
-            dead += 1
+            dead.append(r)
     return dead
+
+
+def num_dead_nodes(stale_after: float = 20.0, timeout_ms: int = 1000) -> int:
+    """Count of :func:`dead_ranks` (reference: kvstore.h:287
+    get_num_dead_node over ps-lite's scheduler heartbeat table)."""
+    return len(dead_ranks(stale_after=stale_after, timeout_ms=timeout_ms))
+
+
+def reset_liveness() -> None:
+    """Forget reader-side heartbeat observations (tests, and a monitor
+    re-arming after a pod generation change: stale observations of a
+    previous generation must not instantly re-declare a rejoined rank
+    dead)."""
+    _hb_seen.clear()
+
+
+# --------------------------------------------------- coordination KV store
+
+def kv_set(key: str, value: str) -> None:
+    """Publish to the coordinator's key-value store (overwrite allowed).
+    Raises RuntimeError when no coordination client exists."""
+    client = _client()
+    if client is None:
+        raise RuntimeError("kv_set(%r): no coordination client — was "
+                           "dist.initialize() called?" % key)
+    try:
+        client.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:               # older jaxlib: no overwrite kwarg
+        try:
+            client.key_value_delete(key)
+        except Exception:                                  # noqa: BLE001
+            pass
+        client.key_value_set(key, value)
+
+
+def kv_get(key: str, timeout_ms: int) -> Optional[str]:
+    """Blocking get with a bounded deadline; None on timeout (the caller
+    decides whether an absent key is an error — the checkpoint commit
+    barrier and the pod rendezvous both do, naming the absent rank)."""
+    client = _client()
+    if client is None:
+        raise RuntimeError("kv_get(%r): no coordination client — was "
+                           "dist.initialize() called?" % key)
+    try:
+        v = client.blocking_key_value_get(key, int(timeout_ms))
+    except Exception:                                      # noqa: BLE001
+        return None
+    return v.decode() if isinstance(v, bytes) else v

@@ -31,6 +31,12 @@ reduction-order noise (which a change of world size legitimately
 perturbs on real models).
 
 Exit 0 + ``ELASTIC-DRILL-OK`` on success; any assertion kills CI.
+
+The MULTI-HOST extension of this drill is ``tools/pod_smoke.py``
+(ISSUE 11, CI ``multihost`` job): the same exact one-hot model,
+stride-masked per host, driven through a 2-host coordinated pod that
+survives ``host.die`` (hostkill and silent-wedge) with bit-identical
+parity against an uninterrupted baseline.
 """
 import json
 import os

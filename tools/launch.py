@@ -16,6 +16,14 @@ Launchers:
 Usage:
   python tools/launch.py -n 4 python train.py --kv-store dist_sync
   python tools/launch.py -n 2 --launcher ssh -H hosts python train.py
+
+Pod mode (`--coordinated`): each worker becomes a per-host elastic
+coordinator (`python -m mxnet_tpu.elastic --coordinated -- cmd`) — the
+pod survives a host dying or wedging mid-run by draining, re-forming at
+the surviving world size, and resuming the training command from the
+newest complete checkpoint (docs/architecture/elastic.md):
+
+  python tools/launch.py -n 2 --coordinated -- python train.py
 """
 import argparse
 import os
@@ -118,11 +126,19 @@ def main(argv=None):
     ap.add_argument("-H", "--hostfile", help="hostfile for --launcher ssh")
     ap.add_argument("--port", type=int, default=None,
                     help="coordinator port (default: pick a free one)")
+    ap.add_argument("--coordinated", action="store_true",
+                    help="wrap the command in the per-host elastic pod "
+                         "coordinator (python -m mxnet_tpu.elastic "
+                         "--coordinated): the pod survives host death "
+                         "by drain/reshard/resume")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
     command = [c for c in args.command if c != "--"]
+    if args.coordinated:
+        command = [sys.executable, "-m", "mxnet_tpu.elastic",
+                   "--coordinated", "--"] + command
     if args.launcher == "local":
         rc = launch_local(args, command)
     else:
