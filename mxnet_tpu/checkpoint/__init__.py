@@ -39,7 +39,8 @@ from .atomic import atomic_open, fsync_dir, replace_and_sync
 from .format import (ARRAYS_NAME, MANIFEST_NAME, CheckpointCorrupt,
                      CheckpointError, CheckpointNotFound,
                      CheckpointPodError,
-                     collect_garbage, list_checkpoints, load_latest,
+                     collect_garbage, finalize_staged_pod_saves,
+                     list_checkpoints, load_latest,
                      pod_info, probe_valid, read_checkpoint,
                      reshard_tensors, resolve_layout_spec,
                      write_checkpoint)
@@ -54,6 +55,7 @@ __all__ = [
     "write_checkpoint", "read_checkpoint", "load_latest",
     "reshard_tensors", "resolve_layout_spec",
     "list_checkpoints", "probe_valid", "collect_garbage", "pod_info",
+    "finalize_staged_pod_saves",
     "atomic_open", "fsync_dir", "replace_and_sync",
     "ARRAYS_NAME", "MANIFEST_NAME",
 ]

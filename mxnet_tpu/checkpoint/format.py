@@ -35,7 +35,8 @@ windows it owns into its own ``arrays-p<rank>.npz`` (distinct-window
 ownership is derived from the global device→index map, lowest
 ``(process_index, device id)`` wins, so every host computes the same
 partition without communicating), then publishes its shard record to
-the coordination KV store; rank 0 waits for every record (bounded by
+the coordination KV store AND as a fsynced ``record-p<rank>.json``
+file inside the staging dir; rank 0 waits for every record (bounded by
 ``MXNET_TPU_CKPT_POD_TIMEOUT``), merges them into ONE manifest tagged
 with ``world_size`` + per-entry ``process_index``, and commits with the
 same fsync+rename protocol. A host dying mid-save means rank 0 times
@@ -43,6 +44,16 @@ out and the save aborts AS A UNIT — no partial checkpoint can ever
 commit; ``load_latest`` falls back to the newest complete one. Reads
 reassemble from all per-host files and reshard onto whatever world
 resumes.
+
+Leader death mid-commit (ISSUE 12): if rank 0 itself dies between
+shard-record publication and the manifest commit, the KV records died
+with the coordination service but the record FILES did not — a
+successor leader runs :func:`finalize_staged_pod_saves` to audit each
+orphaned staging dir from disk alone and deterministically finalize
+(all records present + shard files at recorded sizes → commit the
+merged manifest with ``meta.pod_commit`` provenance) or abort (leave
+the dir for retention GC). ``load_latest`` never observes a torn
+manifest on either path.
 """
 from __future__ import annotations
 
@@ -69,7 +80,7 @@ __all__ = [
     "checkpoint_dir_name", "list_checkpoints", "probe_valid",
     "write_checkpoint", "read_manifest", "read_checkpoint", "load_latest",
     "collect_garbage", "resolve_layout_spec", "reshard_tensors",
-    "pod_info",
+    "pod_info", "finalize_staged_pod_saves",
 ]
 
 FORMAT_VERSION = "mxnet_tpu.checkpoint/1"
@@ -87,6 +98,11 @@ _TMP_RE = re.compile(r"^\.tmp-ckpt-\d{10}\.(\d+)\.\d+$")
 # it aged out — a dead pod's residue has no live pid to key on)
 _POD_TMP_RE = re.compile(r"^\.tmp-ckpt-(\d{10})\.pod\.g(.+)$")
 _POD_TMP_MAX_AGE = 3600.0
+# record-p<rank>.json — each host's fsynced shard record INSIDE the
+# staging dir (its KV twin dies with the coordination service; the file
+# is what a successor leader finalizes from)
+_RECORD_NAME = "record-p%d.json"
+_RECORD_RE = re.compile(r"^record-p(\d+)\.json$")
 _TMP_SEQ = itertools.count()
 
 log = logging.getLogger(__name__)
@@ -375,7 +391,22 @@ def _write_checkpoint_pod(base: str, step: int, tensors: Dict[str, Any],
                        for k, v in arrays.items()},
             "tensors": table,
         }
+        # the shard record is ALSO a file in the staging dir (fsynced,
+        # with this rank's view of the manifest meta): coordination-KV
+        # entries die with the coordination service, so a SUCCESSOR
+        # leader — one whose original rank 0 died between record
+        # publication and manifest commit — can still deterministically
+        # audit + finalize (or abort) the save from disk alone
+        # (:func:`finalize_staged_pod_saves`)
+        rec_path = os.path.join(tmp, _RECORD_NAME % rank)
+        with open(rec_path, "w") as f:
+            json.dump(dict(record, meta=meta or {}), f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
         _dist.kv_set("%s/p%d" % (kv_ns, rank), json.dumps(record))
+        # the acceptance ordering drill: the leader dies AFTER its shard
+        # record (file + KV) is published but BEFORE the manifest commit
+        _maybe_crash("after_record")
         if rank != 0:
             # rank-0 manifest commit barrier: the save only "happened"
             # once rank 0 committed; a bounded wait so a dead rank 0
@@ -428,6 +459,10 @@ def _write_checkpoint_pod(base: str, step: int, tensors: Dict[str, Any],
                     "as a unit" % (r, records[r]["file"], size,
                                    int(records[r]["size"]), step))
         manifest = _merge_pod_records(step, records, meta, world)
+        # commit provenance: who landed the manifest, and on which path
+        # (a successor-finalized save records the successor's rank here)
+        manifest.setdefault("meta", {})["pod_commit"] = {
+            "committed_by": 0, "path": "writer", "gen": gen}
         with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
             f.flush()
@@ -827,6 +862,126 @@ def load_latest(base: str, verify: bool = True, mesh=None,
     raise CheckpointNotFound(
         "no loadable checkpoint under %r (%d candidate(s), all invalid)"
         % (base, len(entries)))
+
+
+# -------------------------------------------- successor finalize / abort
+
+def finalize_staged_pod_saves(base: str, by_rank: int = 0) -> List[str]:
+    """Successor-leader audit of orphaned pod staging dirs (ISSUE 12).
+
+    A pod save whose ORIGINAL rank 0 died between shard-record
+    publication and manifest commit leaves a ``.tmp-*.pod.g*`` staging
+    dir holding every host's ``arrays-p<rank>.npz`` plus its fsynced
+    ``record-p<rank>.json`` — everything the commit needed except the
+    commit itself. This function lets the next generation's leader
+    deterministically FINALIZE or ABORT each such dir:
+
+    * every rank's record file present (the full ``world_size`` set,
+      consistently tagged) AND every recorded shard file on disk at its
+      recorded size → merge the records into the manifest rank 0 would
+      have written (rank 0's record carries the meta), commit it with
+      the same fsync→rename protocol, tagged
+      ``meta.pod_commit = {path: "successor", committed_by: <rank>}``;
+      counted ``ckpt_pod_finalized``;
+    * anything missing or inconsistent → LEAVE the dir for retention GC
+      (age / stale generation). Readers never saw it; nothing is torn.
+
+    Staging dirs of the CURRENT generation (``MXNET_TPU_POD_GEN``) are
+    never touched — they may be a live save in flight. Concurrent
+    finalizers (every host resumes through :func:`~mxnet_tpu.elastic.
+    resume_dir`) are safe: both build identical manifests and the
+    rename is atomic — the loser observes the final dir and stands
+    down. Returns the list of finalized checkpoint paths."""
+    from .. import profiler as _profiler
+    finalized: List[str] = []
+    cur_gen = os.environ.get("MXNET_TPU_POD_GEN")
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return finalized
+    for name in sorted(names):
+        m = _POD_TMP_RE.match(name)
+        if m is None:
+            continue
+        step, gen = int(m.group(1)), m.group(2)
+        if cur_gen is not None and gen == cur_gen:
+            continue                    # possibly a live save in flight
+        tmp = os.path.join(base, name)
+        final = os.path.join(base, checkpoint_dir_name(step))
+        if os.path.isdir(final):
+            continue                    # committed; GC reaps the residue
+        try:
+            records: Dict[int, Dict[str, Any]] = {}
+            for fn in os.listdir(tmp):
+                rm = _RECORD_RE.match(fn)
+                if rm is None:
+                    continue
+                with open(os.path.join(tmp, fn)) as f:
+                    records[int(rm.group(1))] = json.load(f)
+            if not records:
+                continue                # pre-record death: nothing to audit
+            worlds = {int(r.get("world_size", 0)) for r in records.values()}
+            if len(worlds) != 1:
+                log.warning("pod finalize: %s holds records of mixed "
+                            "worlds %s; leaving it for GC", tmp,
+                            sorted(worlds))
+                continue
+            world = worlds.pop()
+            if set(records) != set(range(world)):
+                log.warning("pod finalize: %s holds records for ranks "
+                            "%s of world %d — a host died before "
+                            "publishing; leaving the aborted save for "
+                            "GC", tmp, sorted(records), world)
+                continue
+            complete = True
+            for r, rec in sorted(records.items()):
+                fpath = os.path.join(tmp, rec["file"])
+                try:
+                    size = os.path.getsize(fpath)
+                except OSError:
+                    size = -1
+                if size != int(rec["size"]):
+                    log.warning("pod finalize: %s: rank %d's shard file "
+                                "%s is %d bytes, record says %s; leaving "
+                                "the save for GC", tmp, r, rec["file"],
+                                size, rec["size"])
+                    complete = False
+                    break
+            if not complete:
+                continue
+            meta = records[0].get("meta") or {}
+            manifest = _merge_pod_records(step, records, meta, world)
+            manifest.setdefault("meta", {})["pod_commit"] = {
+                "committed_by": int(by_rank), "path": "successor",
+                "gen": gen}
+            # manifest lands under a unique name first so a concurrent
+            # finalizer can never interleave a half-written manifest
+            part = os.path.join(tmp, "%s.%d" % (MANIFEST_NAME,
+                                                os.getpid()))
+            with open(part, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(part, os.path.join(tmp, MANIFEST_NAME))
+            _atomic.fsync_dir(tmp)
+            try:
+                os.rename(tmp, final)
+            except OSError:
+                if not os.path.isdir(final):
+                    raise               # lost to a concurrent finalizer?
+            _atomic.fsync_dir(base)
+            _profiler.incr_counter("ckpt_pod_finalized")
+            log.warning("pod finalize: committed orphaned step-%d save "
+                        "%s (original leader died mid-commit; finalized "
+                        "by rank %d)", step, final, by_rank)
+            finalized.append(final)
+        except (OSError, ValueError, KeyError, CheckpointError) as exc:
+            if os.path.isdir(final):
+                finalized.append(final)     # a concurrent finalizer won
+                continue
+            log.warning("pod finalize: could not audit %s (%s); leaving "
+                        "it for GC", tmp, exc)
+    return finalized
 
 
 # ---------------------------------------------------------- retention GC

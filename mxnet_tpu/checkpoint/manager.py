@@ -281,7 +281,17 @@ class Checkpoint(object):
 
 def restore_latest(directory: str, verify: bool = True) -> Checkpoint:
     """Load the newest valid checkpoint under ``directory`` (corrupt ones
-    are skipped with a warning) as a :class:`Checkpoint` payload."""
+    are skipped with a warning) as a :class:`Checkpoint` payload.
+
+    Orphaned pod staging dirs are finalized-or-abandoned first
+    (``format.finalize_staged_pod_saves``): a save whose leader died
+    mid-commit must surface here as either the newest checkpoint or
+    nothing at all — never a torn manifest."""
+    try:
+        _format.finalize_staged_pod_saves(directory)
+    except Exception:                                      # noqa: BLE001
+        log.warning("restore_latest: pod staging audit failed; loading "
+                    "the newest committed checkpoint", exc_info=True)
     path, tensors, manifest = _format.load_latest(directory, verify=verify)
     return Checkpoint(path, tensors, manifest)
 

@@ -211,6 +211,24 @@ register("MXNET_TPU_CKPT_POD_TIMEOUT", float, 120.0,
          "host's shard record before the manifest commit (and peers "
          "wait for the commit) — a host dying mid-save aborts the save "
          "as a unit instead of committing a partial checkpoint")
+register("MXNET_TPU_KV_RETRIES", int, 2,
+         "coordination KV (dist.kv_set/kv_get): bounded re-attempts of a "
+         "flaking KV operation (injected via the dist.kv fault site, or "
+         "a real transient error) before it propagates; each retry "
+         "counts dist_kv_retry. 0 = fail on the first error")
+register("MXNET_TPU_PROBE_TIMEOUT", float, 2.0,
+         "pod probe ring: per-probe TCP connect/handshake timeout in "
+         "seconds (peer liveness adjudication when the control plane is "
+         "unreachable; docs/architecture/elastic.md leader fail-over)")
+register("MXNET_TPU_PROBE_ATTEMPTS", int, 3,
+         "pod probe ring: probes per peer before its status is final — "
+         "a single dropped SYN must not misjudge a live host; any "
+         "'live' answer wins immediately")
+register("MXNET_TPU_FAILOVER_PORT", int, 0,
+         "pod control plane: fixed TCP port THIS host would re-host the "
+         "coordination KV service on if elected leader (published in "
+         "every generation's membership record); 0 = probe a fresh free "
+         "port per generation")
 register("MXNET_TPU_ELASTIC_MAX_RESTARTS", int, 10,
          "mx.elastic supervisor: restarts allowed before giving up and "
          "returning the child's exit status (exit 143 and crashes both "
@@ -254,6 +272,28 @@ register("MXNET_TPU_SCAN_LAYERS", _parse_scan_layers, "auto",
          "time stops growing with depth; auto = chains of >= 4 verified-"
          "isomorphic blocks, an integer overrides that minimum, off = "
          "always unroll (the scan module is never imported)")
+
+
+def _parse_nancheck(v) -> str:
+    s = str(v).strip().lower()
+    if s in ("", "0", "off", "false", "no", "none"):
+        return "off"
+    if s in ("warn", "warning", "1", "on", "true", "yes"):
+        return "warn"
+    if s == "abort":
+        return "abort"
+    raise ValueError(
+        "MXNET_TPU_NANCHECK must be off|warn|abort, got %r" % (v,))
+
+
+register("MXNET_TPU_NANCHECK", _parse_nancheck, "off",
+         "non-finite step guard: chain a device-side isfinite reduction "
+         "onto every fused train step (zero host syncs — the flag is "
+         "fetched at the epoch log boundary, same place as the metric "
+         "sync) and count loop_nonfinite when any output went "
+         "NaN/Inf. warn = log naming the first non-finite output, "
+         "abort = raise MXNetError there; off = nothing is chained "
+         "(zero cost)")
 
 
 def _parse_remat(v) -> str:

@@ -39,29 +39,44 @@ Multi-host pod mode (ISSUE 11)::
 
 Each host runs ONE :class:`PodCoordinator` (rank/world from the same
 DMLC_* env the launcher sets). The coordinators form the pod's control
-plane over the ``jax.distributed`` coordination service — a
-coordination CLIENT only; the no-jax-backend discipline above still
-holds — and publish liveness heartbeats (``dist.heartbeat_start``): a
-host that dies (SIGKILL) or freezes whole (SIGSTOP — a stuck machine)
-stops beating and is caught by the
-``MXNET_KVSTORE_HEARTBEAT_STALE_SECS`` deadline. On a death the
+plane over a tiny RE-HOSTABLE KV service (``dist.PodKVServer`` — the
+reference's ps-lite scheduler was its own process too; a
+``jax.distributed`` client is NOT survivable here, see
+``parallel/dist.py``), hosted by the current LEADER — the lowest live
+rank, rank 0 at bootstrap. Every coordinator publishes liveness
+heartbeats (``dist.heartbeat_start``): a host that dies (SIGKILL) or
+freezes whole (SIGSTOP — a stuck machine) stops beating and is caught
+by the ``MXNET_KVSTORE_HEARTBEAT_STALE_SECS`` deadline. On a death the
 survivors DRAIN (SIGTERM the child, escalate to SIGKILL after
 ``MXNET_TPU_ELASTIC_DRAIN_GRACE``), re-rendezvous at the surviving
-world size (generation bump; the leader — the lowest live rank —
-publishes membership + a fresh data-plane coordinator port), and
-relaunch: the children resume from the newest COMPLETE checkpoint,
-resharding onto the new world. A training CHILD failing with its
-supervisor alive (crash, preemption, or — with the opt-in
-``MXNET_TPU_ELASTIC_STALL_SECS`` watchdog — a wedged child) triggers a
-POD-WIDE restart at the unchanged membership instead: bulk-synchronous
-SPMD cannot restart one rank alone, and a child-level stall is
-symmetric across the pod (every peer blocks in the same collective),
-so eviction would be wrong. Counters: ``elastic_dead_host``,
-``elastic_reshard``, ``elastic_restart``, ``elastic_stall``; gauge
-``elastic_world``. Rank 0 hosts the control plane (like the
-reference's ps-lite scheduler): rank 0's host dying ends the pod — the
-cluster manager restarts the whole job, which then resumes from
-checkpoints.
+world size (generation bump; the leader publishes membership — each
+member's host, probe-ring port and fail-over port — plus a fresh
+data-plane coordinator port), and relaunch: the children resume from
+the newest COMPLETE checkpoint, resharding onto the new world. A
+training CHILD failing with its supervisor alive (crash, preemption,
+or — with the opt-in ``MXNET_TPU_ELASTIC_STALL_SECS`` watchdog — a
+wedged child) triggers a POD-WIDE restart at the unchanged membership
+instead: bulk-synchronous SPMD cannot restart one rank alone, and a
+child-level stall is symmetric across the pod (every peer blocks in
+the same collective), so eviction would be wrong.
+
+LEADER FAIL-OVER (ISSUE 12): when the control plane itself goes dark —
+the leader's host died, or only its KV service did — every survivor's
+``dead_ranks`` reports EVERY member unreadable. That is ambiguous
+("the leader is dead" vs "I am partitioned"), so the survivors
+adjudicate over the peer-to-peer PROBE RING (``dist.ProbeRing``; the
+addresses came from the generation's membership record, no control
+plane needed): live + positively-refused peers are accounted, and when
+the live set is a majority of the unaccounted-excluded membership the
+pod recovers IN PLACE — the lowest live rank is elected
+(``dist.elect_leader``), re-hosts the KV service on its published
+fail-over port, every survivor re-points its client, and the next
+generation rendezvous proceeds as after any other host death. Only a
+true minority partition drains and exits 1 for a cluster-manager job
+restart. Counters: ``elastic_dead_host``, ``elastic_reshard``,
+``elastic_restart``, ``elastic_stall``, ``elastic_leader_failover``;
+gauges ``elastic_world``, ``elastic_leader`` (the current leader's
+original pod rank).
 
 Environment exported to every attempt:
 
@@ -102,8 +117,21 @@ def resume_dir(base: str) -> Optional[str]:
     """``base`` if it holds at least one VALID checkpoint, else None —
     the one-liner a training script needs to pass
     ``fit(resume_from=...)`` only when there is something to resume
-    (attempt 0 of an elastic run starts from scratch)."""
+    (attempt 0 of an elastic run starts from scratch).
+
+    Orphaned pod staging dirs are audited first
+    (``finalize_staged_pod_saves``): a save whose original leader died
+    between shard-record publication and manifest commit is finalized
+    by the resuming generation — or provably left for GC — BEFORE the
+    newest-checkpoint decision, so the pod never resumes older work
+    than it durably has."""
     from .checkpoint import format as _format
+    try:
+        _format.finalize_staged_pod_saves(
+            str(base), by_rank=int(os.environ.get("DMLC_WORKER_ID", "0")))
+    except Exception:                                      # noqa: BLE001
+        log.warning("resume_dir: pod staging audit failed; resuming "
+                    "from the newest committed checkpoint", exc_info=True)
     for _step, path in reversed(_format.list_checkpoints(str(base))):
         if _format.probe_valid(path):
             return str(base)
@@ -336,15 +364,17 @@ SELF_DEAD_RC = 75
 class PodCoordinator(object):
     """Per-host pod supervisor (``--coordinated``; module docstring).
 
-    One coordinator runs on every host. Control plane: the
-    ``jax.distributed`` coordination service on the DMLC coordinator
-    address (a TCP client — no jax backend is ever initialized in this
-    process). Liveness: plain heartbeats that freeze exactly when this
-    process does. A dead or frozen host triggers pod-wide drain →
-    rendezvous at the surviving world → relaunch, with the children
-    resuming from the newest complete checkpoint (reshard-on-load); a
-    child-level failure triggers a pod-wide restart at the unchanged
-    membership.
+    One coordinator runs on every host. Control plane: the re-hostable
+    ``dist.PodKVServer`` on the DMLC coordinator address, hosted by the
+    current leader (lowest live rank; no jax backend — nor even a jax
+    coordination client — ever exists in this process). Liveness: plain
+    heartbeats that freeze exactly when this process does. A dead or
+    frozen host triggers pod-wide drain → rendezvous at the surviving
+    world → relaunch, with the children resuming from the newest
+    complete checkpoint (reshard-on-load); a child-level failure
+    triggers a pod-wide restart at the unchanged membership; the
+    LEADER's death triggers probe-ring adjudication and a control-plane
+    re-host on the elected successor's fail-over port.
     """
 
     def __init__(self, argv: Sequence[str],
@@ -405,6 +435,20 @@ class PodCoordinator(object):
         self.restarts = 0
         self.reshards = 0
         self.dead_hosts = 0
+        self.leader_failovers = 0
+        # current pod membership (ORIGINAL ranks — stable identity across
+        # control-plane re-hostings), the latest generation's per-member
+        # info (host, probe-ring port, fail-over port), and the current
+        # leader (= the control-plane host)
+        self.members: List[int] = list(range(self.world))
+        self.peer_info: dict = {}
+        self.leader = 0
+        self.cp_addr = self.coordinator
+        self._kv_server = None
+        self._kv_client = None
+        self._ring = None
+        self._failover_live: Optional[List[int]] = None
+        self._coordsvc_kill = False
         self._child: Optional[subprocess.Popen] = None
         self._terminated = False
         self._progress_path: Optional[str] = None
@@ -415,40 +459,181 @@ class PodCoordinator(object):
     def _dead_peers(self, members) -> List[int]:
         from .parallel import dist as _dist
         dead = _dist.dead_ranks(stale_after=self.stale_after,
-                                timeout_ms=1000)
+                                timeout_ms=1000, ranks=list(members))
         return [r for r in dead if r in members]
+
+    def _failover_port(self) -> int:
+        """The TCP port THIS host would re-host the control plane on if
+        elected (published in every generation's join record). A fresh
+        free port per generation by default; the
+        ``MXNET_TPU_FAILOVER_PORT`` knob pins it (production: a port the
+        window between publication and use cannot leak away)."""
+        from . import config as _config
+        port = int(_config.get("MXNET_TPU_FAILOVER_PORT"))
+        if port > 0:
+            return port
+        from .parallel import dist as _dist
+        return _dist.free_port()
+
+    def _probe_statuses(self, members) -> dict:
+        """Probe every member's ring (bounded attempts; any 'live'
+        answer wins): rank -> live | dead | unreachable."""
+        from . import config as _config
+        from .parallel import dist as _dist
+        attempts = max(1, int(_config.get("MXNET_TPU_PROBE_ATTEMPTS")))
+        statuses = {}
+        for r in members:
+            if r == self.rank:
+                statuses[r] = "live"
+                continue
+            info = self.peer_info.get(r) or {}
+            addr = "%s:%s" % (info.get("host", ""), info.get("probe", 0))
+            status = "unreachable"
+            for _ in range(attempts):
+                status = _dist.probe_peer(addr)
+                if status == "live":
+                    break
+                time.sleep(0.1)
+            statuses[r] = status
+        return statuses
+
+    def _adjudicate(self, members) -> str:
+        """The control plane is unreachable (every member's heartbeat
+        unreadable, ourselves included). That conflates two very
+        different situations — "the leader's host died" and "I am the
+        one partitioned" — so adjudicate over the probe ring, which
+        needs no control plane: positively-refused peers (the host's
+        TCP stack answered, the coordinator is gone) are CONFIRMED
+        dead and excluded from the electorate; a live MAJORITY of the
+        rest recovers in place (``"leader-lost"`` → fail-over), and
+        anything less means this side of a partition must exit for a
+        job restart (``"control-plane-lost"``)."""
+        statuses = self._probe_statuses(members)
+        live = sorted(r for r, s in statuses.items() if s == "live")
+        confirmed_dead = sorted(r for r, s in statuses.items()
+                                if s == "dead")
+        electorate = len(members) - len(confirmed_dead)
+        log.warning("pod: control plane unreachable; probe ring says "
+                    "live=%s confirmed-dead=%s unreachable=%s",
+                    live, confirmed_dead,
+                    sorted(r for r, s in statuses.items()
+                           if s == "unreachable"))
+        if 2 * len(live) > electorate:
+            self._failover_live = live
+            log.warning("pod: healthy majority (%d of %d accountable) — "
+                        "electing a new leader and re-hosting the "
+                        "control plane", len(live), electorate)
+            return "leader-lost"
+        log.error("pod: only %d of %d accountable members reachable — "
+                  "this host is on the minority side of a partition; "
+                  "draining and exiting for a job restart",
+                  len(live), electorate)
+        return "control-plane-lost"
+
+    def _kill_control_plane(self) -> None:
+        """The ``coordsvc`` fault kind (split-brain drill): abruptly
+        stop the control-plane KV service this coordinator hosts while
+        the host — and the training child — stay up."""
+        if self._kv_server is not None:
+            log.warning("pod: coordsvc fault — abruptly stopping the "
+                        "hosted control-plane KV service (host stays up)")
+            self._kv_server.stop()
+            self._kv_server = None
+        else:
+            log.warning("pod: coordsvc fault delivered to a coordinator "
+                        "hosting no control-plane service; ignored")
+
+    def _failover(self) -> bool:
+        """Re-host the control plane after a leader loss: elect the
+        lowest live rank (every survivor computes the same answer from
+        the same generation record — no communication needed, and none
+        available), bind its published fail-over port, re-point every
+        client, restart heartbeats. Returns False when the re-host
+        cannot complete (the caller exits for a job restart)."""
+        from . import profiler as _profiler
+        from .parallel import dist as _dist
+        live = self._failover_live or [self.rank]
+        self._failover_live = None
+        survivors = sorted(live)
+        leader = _dist.elect_leader(survivors)
+        info = self.peer_info.get(leader) or {}
+        port = int(info.get("failover") or 0)
+        host = info.get("host") or "127.0.0.1"
+        if not port:
+            log.error("pod: rank %d published no fail-over port; cannot "
+                      "re-host the control plane", leader)
+            return False
+        addr = "%s:%d" % (host, port)
+        _dist.heartbeat_stop()
+        _dist.reset_liveness()
+        if self._kv_server is not None:     # old control plane, if ours
+            self._kv_server.stop()
+            self._kv_server = None
+        if leader == self.rank:
+            try:
+                self._kv_server = _dist.PodKVServer(port=port)
+            except OSError as exc:
+                log.error("pod: elected leader could not bind the "
+                          "fail-over port %s: %s", addr, exc)
+                return False
+        self._kv_client = _dist.PodKVClient(addr)
+        if not self._kv_client.ping(self.bootstrap_timeout):
+            log.error("pod: the re-hosted control plane at %s never "
+                      "answered within %.0fs (the elected leader died "
+                      "mid-fail-over?)", addr, self.bootstrap_timeout)
+            return False
+        _dist.set_kv_backend(self._kv_client)
+        _dist.heartbeat_start(period=self.heartbeat_period,
+                              as_rank=self.rank)
+        self.members = survivors
+        self.leader = leader
+        self.cp_addr = addr
+        self.leader_failovers += 1
+        _profiler.incr_counter("elastic_leader_failover")
+        _profiler.set_gauge("elastic_leader", leader)
+        log.warning("pod: control plane re-hosted on rank %d (%s); "
+                    "surviving members %s", leader, addr, survivors)
+        return True
 
     # ---------------------------------------------------------- rendezvous
     def _rendezvous(self, gen: int) -> Optional[dict]:
         """Agree on generation ``gen``'s membership. Every live
-        coordinator publishes a join key; the leader (lowest live rank)
+        coordinator publishes a join key carrying its host, probe-ring
+        port and fail-over port; the leader (lowest live member)
         collects joins within the rendezvous window and publishes the
-        member list + a fresh data-plane coordinator port; followers
-        wait for that record (bounded). Returns the record, or None when
-        this rank was judged dead and evicted."""
+        member list, the per-member info map (what a later fail-over
+        election runs on) and a fresh data-plane coordinator port;
+        followers wait for that record (bounded). Returns the record,
+        or None when this rank was judged dead and evicted."""
         import json
+        from . import profiler as _profiler
         from .parallel import dist as _dist
+        join = {"host": self.advertise,
+                "probe": self._ring.port if self._ring is not None else 0,
+                "failover": self._failover_port()}
         _dist.kv_set("mxpod/g%d/join/%d" % (gen, self.rank),
-                     json.dumps({"host": self.advertise}))
+                     json.dumps(join))
         dead = set()
         if gen > 0:
-            dead = set(_dist.dead_ranks(stale_after=self.stale_after,
-                                        timeout_ms=1000))
+            dead = set(self._dead_peers(self.members))
             dead.discard(self.rank)   # we are here, deciding to continue
-        leader = min(r for r in range(self.world) if r not in dead)
+        candidates = [r for r in self.members if r not in dead]
+        leader = _dist.elect_leader(candidates)
         key = "mxpod/g%d/members" % gen
         if leader == self.rank:
-            members = []
+            members, peers = [], {}
             deadline = time.monotonic() + (
                 self.bootstrap_timeout if gen == 0
                 else self.rendezvous_window)
-            for r in range(self.world):
-                if r in dead:
-                    continue
+            for r in candidates:
                 left_ms = max(1, int((deadline - time.monotonic()) * 1000))
                 raw = _dist.kv_get("mxpod/g%d/join/%d" % (gen, r), left_ms)
                 if raw is not None:
                     members.append(r)
+                    try:
+                        peers[str(r)] = json.loads(raw)
+                    except ValueError:
+                        peers[str(r)] = {}
                 elif gen == 0:
                     raise RuntimeError(
                         "pod rendezvous: rank %d of %d never joined "
@@ -460,6 +645,7 @@ class PodCoordinator(object):
                                 "rendezvous window; continuing without "
                                 "it", r, gen)
             rec = {"gen": gen, "ranks": members, "leader": self.rank,
+                   "peers": peers,
                    "coordinator": "%s:%d" % (self.advertise,
                                              _dist.free_port())}
             _dist.kv_set(key, json.dumps(rec))
@@ -473,12 +659,19 @@ class PodCoordinator(object):
             if raw is None:
                 raise RuntimeError(
                     "pod rendezvous: the leader never published "
-                    "generation-%d membership within %.0fs (leader host "
-                    "dead? rank 0's host carries the control plane)"
-                    % (gen, wait))
+                    "generation-%d membership within %.0fs (leader "
+                    "host dead mid-rendezvous? the monitor adjudicates "
+                    "over the probe ring)" % (gen, wait))
             rec = json.loads(raw)
+        # every member learns the full membership + data-plane info here:
+        # a later fail-over election needs no control plane at all
+        self.peer_info = {int(r): info
+                          for r, info in (rec.get("peers") or {}).items()}
+        self.leader = int(rec.get("leader", min(rec["ranks"])))
+        _profiler.set_gauge("elastic_leader", self.leader)
         if self.rank not in rec["ranks"]:
             return None                           # judged dead: evicted
+        self.members = list(rec["ranks"])
         return rec
 
     # --------------------------------------------------------------- child
@@ -531,8 +724,29 @@ class PodCoordinator(object):
         import tempfile
         from . import profiler as _profiler
         from .parallel import dist as _dist
-        _dist.initialize(coordinator_address=self.coordinator,
-                         num_processes=self.world, process_id=self.rank)
+        # control plane: OUR re-hostable KV service, not a jax
+        # coordination client (which LOG(FATAL)s the process when its
+        # service dies — the exact event fail-over survives; see
+        # parallel/dist.py). The gen-0 leader binds the DMLC coordinator
+        # port; followers wait for it within the bootstrap window. The
+        # probe ring starts first so the join record can publish its port.
+        self._ring = _dist.ProbeRing()
+        if self.rank == 0:
+            host_s, _, port_s = self.coordinator.rpartition(":")
+            try:
+                self._kv_server = _dist.PodKVServer(port=int(port_s))
+            except (OSError, ValueError) as exc:
+                raise RuntimeError(
+                    "pod bootstrap: rank 0 could not bind the "
+                    "control-plane port of %s: %s"
+                    % (self.coordinator, exc))
+        self._kv_client = _dist.PodKVClient(self.coordinator)
+        if not self._kv_client.ping(self.bootstrap_timeout):
+            raise _dist.BootstrapTimeout(
+                "pod bootstrap: the control plane at %s never answered "
+                "within %.0fs — is rank 0's coordinator up?"
+                % (self.coordinator, self.bootstrap_timeout))
+        _dist.set_kv_backend(self._kv_client)
         # plain liveness beat: it freezes exactly when this PROCESS does
         # (killed, or SIGSTOPped like a stuck host) — which is the one
         # signal that justifies EVICTING a host. A wedged CHILD with a
@@ -540,10 +754,14 @@ class PodCoordinator(object):
         # bulk-synchronous training stalls symmetrically (every peer
         # blocks in the same collective), so child-progress coupling
         # would make every host judge itself dead at once. That case is
-        # the stall watchdog's (pod-wide restart, _monitor).
-        _dist.heartbeat_start(period=self.heartbeat_period)
+        # the stall watchdog's (pod-wide restart, _monitor). Published
+        # under the ORIGINAL pod rank: identity survives re-hosting.
+        _dist.heartbeat_start(period=self.heartbeat_period,
+                              as_rank=self.rank)
+        _profiler.set_gauge("elastic_leader", 0)
         self._workdir = tempfile.mkdtemp(prefix="mxpod_r%d_" % self.rank)
         restore_sig = self._install_forwarder()
+        restore_usr1 = self._install_coordsvc_handler()
         gen = 0
         prev_world: Optional[int] = None
         try:
@@ -571,7 +789,41 @@ class PodCoordinator(object):
                     return 143
                 self._progress_path = os.path.join(
                     self._workdir, "progress-g%d" % gen)
-                rec = self._rendezvous(gen)
+                try:
+                    rec = self._rendezvous(gen)
+                except Exception:                          # noqa: BLE001
+                    if gen == 0:
+                        raise          # bootstrap errors stay legible
+                    # the control plane died BEFORE or DURING this
+                    # rendezvous (leader lost while we were handling a
+                    # child death, or a cascade mid-rendezvous):
+                    # adjudicate and fail over like the monitor would,
+                    # then RETRY the SAME generation on the re-hosted
+                    # control plane — peers that took the monitor path
+                    # arrive at this generation number too, and the new
+                    # KV incarnation starts empty, so the half-published
+                    # join cannot linger
+                    log.warning("pod: generation-%d rendezvous lost the "
+                                "control plane; adjudicating over the "
+                                "probe ring", gen)
+                    if self._adjudicate(self.members) != "leader-lost" \
+                            or not self._failover():
+                        _dist.heartbeat_stop()
+                        return 1
+                    # the retry consumes restart budget like every other
+                    # fail-over: a flapping elected host (each re-hosted
+                    # control plane dying before it publishes the
+                    # membership) must exhaust the budget and exit for a
+                    # job restart, never cycle this generation forever
+                    if self.restarts >= self.max_restarts:
+                        log.error("pod: restart budget exhausted (%d) "
+                                  "during rendezvous fail-over; giving "
+                                  "up", self.max_restarts)
+                        _dist.heartbeat_stop()
+                        return 1
+                    self.restarts += 1
+                    _profiler.incr_counter("elastic_restart")
+                    continue
                 if rec is None:
                     log.error("pod: this host (rank %d) was judged dead "
                               "and evicted from generation %d; exiting "
@@ -612,11 +864,24 @@ class PodCoordinator(object):
                     _dist.heartbeat_stop()
                     return SELF_DEAD_RC
                 if outcome == "control-plane-lost":
+                    # minority side of a partition: a job restart is the
+                    # only sound recovery (never SELF_DEAD_RC — nothing
+                    # says this MACHINE is broken)
                     _dist.heartbeat_stop()
                     return 1
-                # "drained" (peer death) and a child crash/preemption
-                # both consume restart budget: a flapping pod must not
-                # relaunch forever
+                if outcome == "leader-lost":
+                    # the control plane died but a healthy majority
+                    # survives: elect + re-host, then re-rendezvous at
+                    # the next generation like any other host death
+                    if not self._failover():
+                        _dist.heartbeat_stop()
+                        log.error("pod: leader fail-over could not "
+                                  "complete; ending the pod for a job "
+                                  "restart")
+                        return 1
+                # "drained" (peer death), "leader-lost" (fail-over) and
+                # a child crash/preemption all consume restart budget: a
+                # flapping pod must not relaunch forever
                 if self.restarts >= self.max_restarts:
                     rc = outcome if isinstance(outcome, int) else 1
                     log.error("pod: restart budget exhausted (%d); "
@@ -628,15 +893,46 @@ class PodCoordinator(object):
                 gen += 1
         finally:
             _dist.heartbeat_stop()
+            if self._ring is not None:
+                self._ring.stop()
             if restore_sig is not None:
                 restore_sig()
+            if restore_usr1 is not None:
+                restore_usr1()
+            # NB: a hosted KV server is deliberately NOT stopped here —
+            # the done barrier in main() still rides it; the hard exit
+            # reaps it
+
+    def _install_coordsvc_handler(self):
+        """SIGUSR1 = the ``coordsvc`` fault kind's delivery channel: set
+        ONE flag (async-signal-safe); the monitor loop performs the
+        actual service kill."""
+        if not hasattr(signal, "SIGUSR1"):
+            return None
+        try:
+            prev = signal.getsignal(signal.SIGUSR1)
+
+            def _handler(_signum, _frame):
+                self._coordsvc_kill = True
+
+            signal.signal(signal.SIGUSR1, _handler)
+        except (ValueError, OSError):
+            return None             # not the main thread
+
+        def _restore():
+            try:
+                signal.signal(signal.SIGUSR1, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+
+        return _restore
 
     def _settle(self) -> None:
         """One full staleness window of liveness observation before a
         rendezvous decides membership."""
         from .parallel import dist as _dist
-        _dist.dead_ranks(stale_after=self.stale_after,
-                         timeout_ms=1000)          # prime observations
+        _dist.dead_ranks(stale_after=self.stale_after, timeout_ms=1000,
+                         ranks=list(self.members))  # prime observations
         deadline = time.monotonic() + self.stale_after \
             + 2.0 * self.heartbeat_period
         while not self._terminated:
@@ -650,7 +946,11 @@ class PodCoordinator(object):
         0), ``"terminated"`` (supervisor SIGTERMed), ``"self-dead"``
         (our own heartbeat went stale — wedged child), ``"drained"`` (a
         peer died/wedged or requested a pod-wide restart; child drained,
-        rendezvous next generation), or the child's nonzero exit code
+        rendezvous next generation), ``"leader-lost"`` (the control
+        plane is unreachable but the probe ring confirms a healthy
+        majority — fail over), ``"control-plane-lost"`` (unreachable AND
+        this host is a probe-ring minority: the partitioned side exits
+        for a job restart), or the child's nonzero exit code
         (crash/preemption — published as a pod-wide restart request:
         SPMD training cannot restart one rank alone, every host must
         drain and re-enter together)."""
@@ -691,21 +991,36 @@ class PodCoordinator(object):
                     log.warning("pod: child died (%s)",
                                 "signal %d" % -rc if rc < 0
                                 else "exit %d" % rc)
-                _dist.kv_set(restart_key,
-                             json.dumps({"rank": self.rank, "rc": rc}))
+                try:
+                    _dist.kv_set(restart_key,
+                                 json.dumps({"rank": self.rank,
+                                             "rc": rc}))
+                except Exception:                          # noqa: BLE001
+                    # a dark control plane must not mask the child's
+                    # status; the next loop/generation adjudicates it
+                    log.warning("pod: could not publish the pod-wide "
+                                "restart request (control plane dark?)")
                 return rc if rc != 0 else 1
+            if self._coordsvc_kill:
+                # SIGUSR1 from a child's coordsvc fault: perform the
+                # abrupt service kill OUTSIDE the handler (flag-only
+                # handlers; the repo's signal-unsafe lint rule)
+                self._coordsvc_kill = False
+                self._kill_control_plane()
             dead = self._dead_peers(members)
             if len(dead) >= len(members):
-                # EVERY rank unreadable, ourselves included, means the
-                # coordination service itself is gone — rank 0's host
-                # died (the documented control-plane limit). That is a
-                # JOB failure for the cluster manager to restart, not
-                # evidence that this machine is broken: do NOT exit
-                # SELF_DEAD_RC, which asks for the machine's replacement
-                log.error("pod: the control plane is unreachable (rank "
-                          "0's host dead?); draining and ending the pod")
-                self._drain_child()
-                return "control-plane-lost"
+                # EVERY member unreadable, ourselves included: the KV
+                # control plane itself is unreachable. Re-observe once
+                # (a transient server hiccup must not trigger an
+                # election), then adjudicate over the probe ring: a
+                # healthy majority fails over in place, a minority
+                # partition drains and exits for a job restart.
+                time.sleep(min(1.0, self.heartbeat_period))
+                dead = self._dead_peers(members)
+                if len(dead) >= len(members):
+                    outcome = self._adjudicate(members)
+                    self._drain_child()
+                    return outcome
             if self.rank in dead:
                 # defensive: our own beat stopped advancing (publisher
                 # thread died, coordinator-side eviction) — the pod has
@@ -724,7 +1039,11 @@ class PodCoordinator(object):
                             dead, self.stale_after)
                 self._drain_child()
                 return "drained"
-            if _dist.kv_get(restart_key, 50) is not None:
+            try:
+                restart_req = _dist.kv_get(restart_key, 50)
+            except Exception:                              # noqa: BLE001
+                restart_req = None      # KV flake past its retry budget
+            if restart_req is not None:
                 log.warning("pod: a peer requested a pod-wide restart "
                             "of generation %d; draining", gen)
                 self._drain_child()
@@ -749,8 +1068,11 @@ class PodCoordinator(object):
                     log.warning("pod: child progress stalled past "
                                 "%.0fs; requesting a pod-wide restart",
                                 self.stall_after)
-                    _dist.kv_set(restart_key, json.dumps(
-                        {"rank": self.rank, "stall": True}))
+                    try:
+                        _dist.kv_set(restart_key, json.dumps(
+                            {"rank": self.rank, "stall": True}))
+                    except Exception:                      # noqa: BLE001
+                        pass        # dark control plane: drain anyway
                     self._drain_child()
                     return "drained"
             time.sleep(poll)
@@ -832,33 +1154,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # machine-readable exit record: the pod drill (and operators'
         # log scrapers) assert on these without reaching into the process
         print("POD-COORDINATOR-EXIT rank=%d rc=%d restarts=%d "
-              "reshards=%d dead_hosts=%d counters=%s"
+              "reshards=%d dead_hosts=%d failovers=%d counters=%s"
               % (coord.rank, rc, coord.restarts, coord.reshards,
-                 coord.dead_hosts,
+                 coord.dead_hosts, coord.leader_failovers,
                  json.dumps({k: v for k, v in
                              _profiler.counters().items()
                              if k.startswith("elastic")},
                             sort_keys=True)), flush=True)
         sys.stdout.flush()
         sys.stderr.flush()
-        # Exit order: rank 0 hosts the coordination service, so it must
-        # leave LAST — a peer whose client outlives the leader aborts
-        # fatally over the closed socket. Non-leaders publish done as
-        # their LAST act before the hard exit (nothing in between that
-        # an abort could interrupt); rank 0 collects with a bounded
-        # per-rank wait (dead hosts never publish; skip them after 5s).
+        # Exit order: the CURRENT leader (not necessarily rank 0 after a
+        # fail-over) hosts the control-plane KV service, so it leaves
+        # LAST: members publish done and the leader collects from the
+        # CURRENT membership with a bounded per-rank wait (evicted and
+        # dead hosts are not waited on at all). With the PodKV control
+        # plane a member outliving the leader is harmless — per-request
+        # sockets, no fatal client abort — the ordering just keeps the
+        # done barrier meaningful for operators' log scrapers.
         try:
             from .parallel import dist as _dist
             _dist.kv_set("mxpod/done/%d" % coord.rank, str(rc))
-            if coord.rank == 0:
-                for r in range(1, coord.world):
-                    _dist.kv_get("mxpod/done/%d" % r, 5000)
+            if coord.rank == coord.leader:
+                for r in coord.members:
+                    if r != coord.rank:
+                        _dist.kv_get("mxpod/done/%d" % r, 5000)
         except Exception:                                  # noqa: BLE001
             pass    # a broken control plane must not mask the exit code
-        # HARD exit: jax's atexit distributed-shutdown barrier would wait
-        # on (and then abort over) pod members that died — the exact
-        # event this mode exists to survive. Nothing is left to clean up:
-        # the child is reaped and the exit record is flushed.
+        # HARD exit: the training CHILDREN's jax atexit
+        # distributed-shutdown barrier is their problem (they are
+        # reaped); the coordinator itself never initializes jax, but the
+        # hard exit keeps the exit record the LAST observable act no
+        # matter what library atexit hooks accumulated.
         os._exit(rc if 0 <= rc < 256 else 1)
     return supervise(command, max_restarts=args.max_restarts,
                      backoff=args.backoff, backoff_max=args.backoff_max,

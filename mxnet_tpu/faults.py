@@ -27,12 +27,17 @@ site                 where                         default kind
 ===================  ============================  =====================
 ckpt.arrays_write    writer, start of arrays.npz   eio
 ckpt.after_arrays    writer, arrays fsynced        sigkill
+ckpt.after_record    writer, shard record          sigkill
+                     published (pod saves)
 ckpt.after_manifest  writer, manifest fsynced      sigkill
 ckpt.before_rename   writer, pre-rename (torn)     sigkill
 ckpt.read_manifest   reader, before manifest open  bitflip
 ckpt.read_arrays     reader, before npz open       bitflip
 fit.batch            fit loop, each batch start    sigterm
 host.die             fit loop, each batch start    hostkill
+leader.die           fit loop, each batch start    hostkill
+                     (arm on the leader's host)
+dist.kv              dist.kv_set / dist.kv_get     raise
 serve.submit         InferenceServer.submit        raise
 ===================  ============================  =====================
 
@@ -46,7 +51,9 @@ the site's file in half and returns; ``hostkill`` SIGKILLs the
 coordinated supervisor (parent) and then this process — the whole host
 vanishes, the pod drill's node-loss model; ``wedge`` stops making
 progress while staying alive (the failure only a heartbeat deadline
-catches).
+catches); ``coordsvc`` SIGUSR1s the coordinated supervisor, which
+abruptly stops the control-plane KV service it hosts while every host
+stays up — the split-brain shape only the probe ring can adjudicate.
 
 Every fired fault bumps the ``fault_injected`` profiler counter (plus
 ``fault_injected.<site>``) *before* acting, and — when
@@ -71,16 +78,17 @@ ENV = "MXNET_TPU_FAULTS"
 LEGACY_ENV = "MXNET_TPU_CKPT_TEST_CRASH"
 
 KINDS = ("eio", "enospc", "eintr", "raise", "sigterm", "sigkill",
-         "bitflip", "truncate", "hostkill", "wedge")
+         "bitflip", "truncate", "hostkill", "wedge", "coordsvc")
 
 # the shipped injection points (docs/architecture/elastic.md catalog).
 # A spec naming a site outside this set is accepted — new sites must be
 # armable before the catalog ships — but WARNED about: a typo'd site
 # never fires and the drill vacuously passes as "recovered"
 SITES = frozenset((
-    "ckpt.arrays_write", "ckpt.after_arrays", "ckpt.after_manifest",
-    "ckpt.before_rename", "ckpt.read_manifest", "ckpt.read_arrays",
-    "fit.batch", "serve.submit", "host.die",
+    "ckpt.arrays_write", "ckpt.after_arrays", "ckpt.after_record",
+    "ckpt.after_manifest", "ckpt.before_rename", "ckpt.read_manifest",
+    "ckpt.read_arrays", "fit.batch", "serve.submit", "host.die",
+    "leader.die", "dist.kv",
 ))
 
 # kinds that model a HOST dying rather than one process failing
@@ -307,6 +315,20 @@ def fire(site: str, path: Optional[str] = None,
             except OSError:
                 pass
         os.kill(os.getpid(), signal.SIGKILL)
+        return
+    if kind == "coordsvc":
+        # kill ONLY the coordination service while the host stays up —
+        # the split-brain shape: SIGUSR1 the coordinated supervisor,
+        # whose flag-only handler abruptly stops its control-plane KV
+        # server (when it hosts one). This process keeps training; the
+        # data plane is untouched. Guarded by the coordinator's env
+        # marker like hostkill — never signal an arbitrary parent.
+        if os.environ.get("MXNET_TPU_ELASTIC_COORDINATED") \
+                and hasattr(signal, "SIGUSR1"):
+            try:
+                os.kill(os.getppid(), signal.SIGUSR1)
+            except OSError:
+                pass
         return
     if kind == "wedge":
         # the silent failure: the whole HOST freezes — alive, responsive

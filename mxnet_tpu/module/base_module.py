@@ -487,9 +487,13 @@ class BaseModule(object):
                         # elastic suite SIGTERMs/SIGKILLs fit at batch K
                         # (MXNET_TPU_FAULTS=fit.batch@K[:kind]); the pod
                         # drill kills or wedges the whole HOST here
-                        # (host.die@K[:hostkill|wedge])
+                        # (host.die@K[:hostkill|wedge]); the leader
+                        # fail-over drill arms leader.die on the host
+                        # carrying the control plane
+                        # (leader.die@K[:hostkill|coordsvc])
                         _faults.fire("fit.batch", default_kind="sigterm")
                         _faults.fire("host.die", default_kind="hostkill")
+                        _faults.fire("leader.die", default_kind="hostkill")
                     data_batch = next_data_batch
                     # the batch's flow id threads its trace slices across
                     # lanes (prefetch -> place -> step -> metric); batches
@@ -575,6 +579,24 @@ class BaseModule(object):
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
                 toc = time.perf_counter()
                 self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+
+                # non-finite step guard (MXNET_TPU_NANCHECK): the ONE
+                # host fetch of the device-accumulated isfinite flags,
+                # at the same boundary as the metric sync — warn logs,
+                # abort raises naming the first non-finite output
+                nan_mode = getattr(self, "_nancheck_mode", "off")
+                if nan_mode != "off":
+                    bad = self._nancheck_poll()
+                    if bad is not None:
+                        _profiler.incr_counter("loop_nonfinite")
+                        msg = ("non-finite values in output %r during "
+                               "epoch %d (MXNET_TPU_NANCHECK=%s; a "
+                               "diverged loss, inf/NaN inputs, or an "
+                               "overflowing update)" % (bad, epoch,
+                                                        nan_mode))
+                        if nan_mode == "abort":
+                            raise MXNetError(msg)
+                        self.logger.warning(msg)
 
                 arg_params_, aux_params_ = self.get_params()
                 self.set_params(arg_params_, aux_params_)

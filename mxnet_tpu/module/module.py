@@ -966,6 +966,17 @@ class Module(BaseModule):
         self._fused_num_update = self._optimizer.num_update
         self._fused_compiles = 0
 
+        # ---- non-finite step guard (MXNET_TPU_NANCHECK): a device-side
+        # isfinite reduction chained onto every fused step — same
+        # pattern as device metrics, zero host syncs; the flags are
+        # fetched once per epoch at the log boundary (_nancheck_poll),
+        # where warn logs and abort raises naming the first non-finite
+        # output. off = nothing built, nothing chained.
+        self._nancheck_mode = _config.get("MXNET_TPU_NANCHECK")
+        self._nancheck_fn = None
+        self._nancheck_idx = ()
+        self._nan_flags = None
+
         def run(data_batch):
             ex = self._exec
             self._load_batch(data_batch)
@@ -1001,6 +1012,8 @@ class Module(BaseModule):
                 else:
                     outs, new_params, new_states, new_aux = \
                         self._fused_jit(*call_args)
+            if self._nancheck_mode != "off":
+                self._nancheck_accumulate(outs)
             if accum > 1:
                 _profiler.incr_counter("accum_steps", accum)
             n = self._obs_steps + 1
@@ -1187,6 +1200,52 @@ class Module(BaseModule):
             self.update()
         else:
             self._fused(data_batch)
+
+    # ------------------------------------------------- non-finite guard
+    def _nancheck_accumulate(self, outs):
+        """Chain one tiny jitted reduction onto this step's outputs:
+        per-output "ever went non-finite" flags accumulated ON DEVICE
+        (async dispatch — the step loop never syncs for it). Integer
+        outputs are skipped; a program with no inexact outputs disables
+        the guard for this bind."""
+        import jax
+        import jax.numpy as jnp
+        if self._nancheck_fn is None:
+            idx = tuple(i for i, o in enumerate(outs)
+                        if jnp.issubdtype(o.dtype, jnp.inexact))
+            if not idx:
+                self._nancheck_mode = "off"
+                return
+            self._nancheck_idx = idx
+
+            @jax.jit
+            def chained(flags, outs_t):
+                return tuple(f | ~jnp.all(jnp.isfinite(outs_t[i]))
+                             for f, i in zip(flags, idx))
+
+            self._nancheck_fn = chained
+        flags = self._nan_flags
+        if flags is None:
+            flags = tuple(jnp.zeros((), jnp.bool_)
+                          for _ in self._nancheck_idx)
+        self._nan_flags = self._nancheck_fn(flags, tuple(outs))
+
+    def _nancheck_poll(self) -> Optional[str]:
+        """The log-boundary host fetch of the chained flags (the ONE
+        sync, same place as the metric sync): returns the name of the
+        first non-finite output, or None. Resets the accumulator so
+        each epoch is judged on its own steps."""
+        flags = self._nan_flags
+        if flags is None:
+            return None
+        import jax
+        host = [bool(v) for v in jax.device_get(flags)]
+        self._nan_flags = None
+        for i, hit in zip(self._nancheck_idx, host):
+            if hit:
+                names = self._output_names or []
+                return names[i] if i < len(names) else "output%d" % i
+        return None
 
     # ------------------------------------------------------------- compute
     def _place_value(self, name, arr):

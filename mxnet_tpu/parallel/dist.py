@@ -20,13 +20,15 @@ from __future__ import annotations
 
 import os
 from functools import partial
-from typing import Callable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 __all__ = ["initialize", "is_initialized", "cluster_env", "rank",
            "num_workers", "allreduce_sum", "broadcast", "barrier",
            "heartbeat_start", "heartbeat_stop", "num_dead_nodes",
            "dead_ranks", "reset_liveness", "kv_set", "kv_get",
-           "free_port", "BootstrapTimeout"]
+           "free_port", "BootstrapTimeout",
+           "PodKVServer", "PodKVClient", "ProbeRing", "probe_peer",
+           "elect_leader", "set_kv_backend", "kv_backend_active"]
 
 
 def free_port() -> int:
@@ -413,8 +415,8 @@ _hb_seen = {}
 
 
 def heartbeat_start(period: Optional[float] = None,
-                    progress_fn: Optional[Callable[[], object]] = None
-                    ) -> bool:
+                    progress_fn: Optional[Callable[[], object]] = None,
+                    as_rank: Optional[int] = None) -> bool:
     """Publish this worker's liveness to the coordinator's key-value store
     every ``period`` seconds (reference: ps-lite worker heartbeats to the
     scheduler, feeding kvstore.h:287 get_num_dead_node). The payload is a
@@ -434,12 +436,16 @@ def heartbeat_start(period: Optional[float] = None,
     care in bulk-synchronous pods — one wedged member stalls EVERY
     member's progress, so progress-coupled beats there make the whole
     pod look dead at once (the pod coordinator publishes a plain beat
-    for exactly this reason)."""
+    for exactly this reason).
+
+    ``as_rank`` names the heartbeat key explicitly (the pod coordinator
+    publishes under its ORIGINAL pod rank across control-plane
+    re-hostings); default is this process's coordination rank."""
     global _hb_started, _hb_stop, _hb_thread
     import logging
     import threading
-    client = _client()
-    if client is None:
+    backend = _kv()
+    if backend is None:
         return False
     if _hb_started:
         return True
@@ -449,7 +455,7 @@ def heartbeat_start(period: Optional[float] = None,
     _hb_started = True
     _hb_stop = threading.Event()
 
-    me = "mxnet_hb/%d" % rank()
+    me = "mxnet_hb/%d" % (rank() if as_rank is None else int(as_rank))
     stop = _hb_stop
 
     def beat():
@@ -468,14 +474,10 @@ def heartbeat_start(period: Optional[float] = None,
                     last_token = token
                     n += 1
             try:
-                try:
-                    client.key_value_set(me, str(n), allow_overwrite=True)
-                except TypeError:   # older jaxlib: no overwrite kwarg
-                    try:
-                        client.key_value_delete(me)
-                    except Exception:
-                        pass
-                    client.key_value_set(me, str(n))
+                # the captured backend, not kv_set: the fault harness's
+                # dist.kv site must keep DETERMINISTIC arrival counts,
+                # and a background beat firing it would wreck them
+                backend.set(me, str(n))
                 warned = False      # recovered: re-arm the warning
             except Exception as exc:
                 # transient coordinator hiccups must not kill the beat —
@@ -504,26 +506,38 @@ def heartbeat_stop(timeout: float = 2.0):
     _hb_started, _hb_stop, _hb_thread = False, None, None
 
 
-def dead_ranks(stale_after: float = 20.0, timeout_ms: int = 1000
-               ) -> List[int]:
+def dead_ranks(stale_after: float = 20.0, timeout_ms: int = 1000,
+               ranks: Optional[Iterable[int]] = None) -> List[int]:
     """Ranks whose heartbeat is missing, or whose beat counter has not
     advanced for ``stale_after`` seconds of the CALLER's clock (two
     observations are needed to declare staleness, so a first call never
     false-positives on a slow-but-alive worker). The pod coordinator
     keys membership decisions on this list; :func:`num_dead_nodes` is
-    its count."""
+    its count.
+
+    ``ranks`` names the heartbeat keys to check (the pod coordinator
+    passes its CURRENT membership's original ranks); default is every
+    coordination rank of this process's world.
+
+    The liveness math reads ``time.monotonic()`` ONLY — an NTP step on
+    either host must never expire a deadline or resurrect a corpse (the
+    ``wall-clock`` lint rule is wired over this module)."""
     import time
-    client = _client()
-    if client is None:
+    backend = _kv()
+    if backend is None:
         return []
+    if ranks is None:
+        ranks = range(num_workers())
     dead: List[int] = []
     now = time.monotonic()
-    for r in range(num_workers()):
+    for r in ranks:
         try:
-            counter = int(client.blocking_key_value_get(
-                "mxnet_hb/%d" % r, timeout_ms))
-        except Exception:
+            counter = int(backend.get("mxnet_hb/%d" % r, timeout_ms))
+        except (TypeError, ValueError):
             dead.append(r)          # never heartbeated within the timeout
+            continue
+        except Exception:                                  # noqa: BLE001
+            dead.append(r)          # backend unreachable: unreadable rank
             continue
         prev = _hb_seen.get(r)
         if prev is None or prev[0] != counter:
@@ -548,34 +562,452 @@ def reset_liveness() -> None:
 
 
 # --------------------------------------------------- coordination KV store
+#
+# Two backends serve the same kv_set/kv_get surface:
+#
+# * the jax.distributed coordination client (training children — the
+#   data plane: the checkpoint commit barrier rides it), and
+# * a :class:`PodKVClient` installed via :func:`set_kv_backend` (the pod
+#   coordinators — the control plane). The control plane CANNOT ride
+#   jax's client: its error-polling thread LOG(FATAL)s the whole process
+#   the moment the coordination service dies (xla client.h
+#   missed_heartbeat_callback; the Python override crashes with
+#   std::bad_cast on this jaxlib) — the exact event leader fail-over
+#   exists to survive. A coordinator losing its KV server must ADJUDICATE
+#   (probe ring), not die.
+
+_KV_BACKEND = None          # PodKVClient installed by the pod coordinator
+
+
+class _JaxKV(object):
+    """Adapter presenting the jax coordination client as a KV backend."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def set(self, key: str, value: str) -> None:
+        try:
+            self._client.key_value_set(key, value, allow_overwrite=True)
+        except TypeError:           # older jaxlib: no overwrite kwarg
+            try:
+                self._client.key_value_delete(key)
+            except Exception:                              # noqa: BLE001
+                pass
+            self._client.key_value_set(key, value)
+
+    def get(self, key: str, timeout_ms: int) -> Optional[str]:
+        try:
+            v = self._client.blocking_key_value_get(key, int(timeout_ms))
+        except Exception:                                  # noqa: BLE001
+            return None
+        return v.decode() if isinstance(v, bytes) else v
+
+
+def set_kv_backend(backend) -> None:
+    """Install (or with ``None`` remove) an explicit KV backend that
+    :func:`kv_set`/:func:`kv_get`/:func:`heartbeat_start`/
+    :func:`dead_ranks` use INSTEAD of the jax coordination client. The
+    pod coordinator points this at its :class:`PodKVClient`; re-pointing
+    it at a re-hosted server is the whole of a control-plane migration."""
+    global _KV_BACKEND
+    _KV_BACKEND = backend
+
+
+def kv_backend_active() -> bool:
+    return _KV_BACKEND is not None or _client() is not None
+
+
+def _kv():
+    if _KV_BACKEND is not None:
+        return _KV_BACKEND
+    client = _client()
+    return _JaxKV(client) if client is not None else None
+
+
+def _kv_retries() -> int:
+    from .. import config as _config
+    return max(0, int(_config.get("MXNET_TPU_KV_RETRIES")))
+
 
 def kv_set(key: str, value: str) -> None:
-    """Publish to the coordinator's key-value store (overwrite allowed).
-    Raises RuntimeError when no coordination client exists."""
-    client = _client()
-    if client is None:
-        raise RuntimeError("kv_set(%r): no coordination client — was "
+    """Publish to the coordination key-value store (overwrite allowed),
+    retrying KV flakes (``MXNET_TPU_KV_RETRIES`` bounded attempts, each
+    counted ``dist_kv_retry``) before the error propagates. Raises
+    RuntimeError when no backend exists. Fault site: ``dist.kv``."""
+    import time
+    from .. import faults as _faults
+    backend = _kv()
+    if backend is None:
+        raise RuntimeError("kv_set(%r): no coordination KV backend — was "
                            "dist.initialize() called?" % key)
-    try:
-        client.key_value_set(key, value, allow_overwrite=True)
-    except TypeError:               # older jaxlib: no overwrite kwarg
+    retries = _kv_retries()
+    for attempt in range(retries + 1):
         try:
-            client.key_value_delete(key)
+            if _faults.ARMED:
+                _faults.fire("dist.kv", default_kind="raise")
+            backend.set(key, value)
+            return
         except Exception:                                  # noqa: BLE001
-            pass
-        client.key_value_set(key, value)
+            if attempt >= retries:
+                raise
+            from .. import profiler as _profiler
+            _profiler.incr_counter("dist_kv_retry")
+            time.sleep(0.05 * (2 ** attempt))
 
 
 def kv_get(key: str, timeout_ms: int) -> Optional[str]:
     """Blocking get with a bounded deadline; None on timeout (the caller
     decides whether an absent key is an error — the checkpoint commit
-    barrier and the pod rendezvous both do, naming the absent rank)."""
-    client = _client()
-    if client is None:
-        raise RuntimeError("kv_get(%r): no coordination client — was "
+    barrier and the pod rendezvous both do, naming the absent rank).
+    Injected KV flakes (fault site ``dist.kv``) are retried with the
+    same bounded budget as :func:`kv_set`; an absent key is NOT a flake
+    and returns None immediately."""
+    import time
+    from .. import faults as _faults
+    backend = _kv()
+    if backend is None:
+        raise RuntimeError("kv_get(%r): no coordination KV backend — was "
                            "dist.initialize() called?" % key)
-    try:
-        v = client.blocking_key_value_get(key, int(timeout_ms))
-    except Exception:                                      # noqa: BLE001
+    retries = _kv_retries()
+    for attempt in range(retries + 1):
+        try:
+            if _faults.ARMED:
+                _faults.fire("dist.kv", default_kind="raise")
+            return backend.get(key, int(timeout_ms))
+        except Exception:                                  # noqa: BLE001
+            if attempt >= retries:
+                raise
+            from .. import profiler as _profiler
+            _profiler.incr_counter("dist_kv_retry")
+            time.sleep(0.05 * (2 ** attempt))
+
+
+# ----------------------------------------- re-hostable pod control plane
+#
+# Reference: the ps-lite scheduler is its own tiny process, not a
+# training worker — and so is this. A line-based TCP KV service the pod
+# coordinators use for rendezvous, heartbeats, restart requests and the
+# done barrier. The LEADER (lowest live rank) hosts it; when the
+# leader's host dies, the successor re-hosts it on its published
+# fail-over port and every survivor re-points its client — no process
+# ever has to survive a jax coordination-service death (see the backend
+# note above).
+#
+# Protocol (one UTF-8 line per request/reply; values base64 so any JSON
+# payload stays line-safe):
+#
+#   SET <key> <b64>          -> OK
+#   GET <key> <timeout_ms>   -> VAL <b64> | NONE   (server-side blocking
+#                               wait for the key, bounded by timeout_ms)
+#   PING                     -> PONG
+
+_KV_MAGIC_PING = b"PING\n"
+_KV_MAGIC_PONG = b"PONG\n"
+
+
+def _b64e(value: str) -> str:
+    import base64
+    return base64.b64encode(value.encode("utf-8")).decode("ascii")
+
+
+def _b64d(value: str) -> str:
+    import base64
+    return base64.b64decode(value.encode("ascii")).decode("utf-8")
+
+
+class PodKVServer(object):
+    """The control-plane KV service (one per pod, on the current
+    leader's host). ``stop()`` is abrupt by design — the ``coordsvc``
+    fault kind drills exactly this shape (service dead, host alive)."""
+
+    def __init__(self, port: int = 0, host: str = ""):
+        import socket
+        import threading
+        self._store: Dict[str, str] = {}
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="mxpod-kv-server",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Close the listener and wake every blocked GET. Idempotent."""
+        import socket
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        try:
+            # shutdown BEFORE close: close() alone leaves a concurrently
+            # accept()-blocked listener alive in the kernel, silently
+            # serving new connections until the next accept returns
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ server
+    def _accept_loop(self) -> None:
+        import socket
+        import threading
+        while True:
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return              # stop() closed the listener
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn) -> None:
+        import time
+        try:
+            conn.settimeout(300.0)
+            rfile = conn.makefile("r", encoding="utf-8", newline="\n")
+            for line in rfile:
+                parts = line.strip().split(" ")
+                if not parts or not parts[0]:
+                    continue
+                op = parts[0]
+                if op == "PING":
+                    conn.sendall(_KV_MAGIC_PONG)
+                elif op == "SET" and len(parts) == 3:
+                    with self._cond:
+                        self._store[parts[1]] = parts[2]
+                        self._cond.notify_all()
+                    conn.sendall(b"OK\n")
+                elif op == "GET" and len(parts) == 3:
+                    deadline = time.monotonic() + int(parts[2]) / 1000.0
+                    with self._cond:
+                        while parts[1] not in self._store \
+                                and not self._stopped:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            self._cond.wait(min(left, 1.0))
+                        val = self._store.get(parts[1])
+                    conn.sendall(("VAL %s\n" % val).encode("ascii")
+                                 if val is not None else b"NONE\n")
+                else:
+                    conn.sendall(b"ERR\n")
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class PodKVClient(object):
+    """One-request-per-connection client of :class:`PodKVServer`.
+
+    Connection failures are judged FAST (a dead server must read as dead
+    within one quick retry, not a full blocking window) — bootstrap
+    patience lives in :meth:`ping`, which retries connecting until its
+    deadline (the follower-waits-for-the-leader's-server window)."""
+
+    def __init__(self, address: str, connect_timeout: Optional[float]
+                 = None):
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self._host = host or "127.0.0.1"
+        self._port = int(port)
+        if connect_timeout is None:
+            from .. import config as _config
+            connect_timeout = float(_config.get("MXNET_TPU_PROBE_TIMEOUT"))
+        self._connect_timeout = float(connect_timeout)
+
+    def _request(self, line: str, read_timeout: float) -> Optional[str]:
+        import socket
+        import time
+        reply = None
+        for attempt in range(2):        # one quick re-dial, then give up
+            try:
+                conn = socket.create_connection(
+                    (self._host, self._port),
+                    timeout=self._connect_timeout)
+            except OSError:
+                time.sleep(0.05)
+                continue
+            try:
+                conn.settimeout(read_timeout)
+                conn.sendall(line.encode("utf-8"))
+                reply = conn.makefile(
+                    "r", encoding="utf-8", newline="\n").readline().strip()
+            except OSError:
+                reply = None
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            if reply:
+                return reply
         return None
-    return v.decode() if isinstance(v, bytes) else v
+
+    def ping(self, deadline_s: float) -> bool:
+        """Bounded wait for the server to answer (bootstrap: the leader
+        may not have bound its port yet)."""
+        import time
+        t_end = time.monotonic() + max(0.0, deadline_s)
+        while True:
+            if self._request("PING\n", read_timeout=2.0) == "PONG":
+                return True
+            if time.monotonic() >= t_end:
+                return False
+            time.sleep(0.2)
+
+    def set(self, key: str, value: str) -> None:
+        reply = self._request("SET %s %s\n" % (key, _b64e(value)),
+                              read_timeout=10.0)
+        if reply != "OK":
+            raise OSError("pod KV server %s unreachable for SET %s"
+                          % (self.address, key))
+
+    def get(self, key: str, timeout_ms: int) -> Optional[str]:
+        reply = self._request(
+            "GET %s %d\n" % (key, int(timeout_ms)),
+            read_timeout=int(timeout_ms) / 1000.0 + 10.0)
+        if reply is None or reply == "NONE":
+            return None
+        if reply.startswith("VAL "):
+            return _b64d(reply[4:])
+        return None
+
+
+# ------------------------------------------------- peer liveness probes
+
+_PROBE_Q = b"mxpr?\n"
+_PROBE_A = b"mxpr!\n"
+
+
+def _recv_exact(conn, n: int) -> bytes:
+    """Read up to ``n`` bytes, looping past short reads; returns what
+    arrived before EOF/timeout. TCP is a byte stream — a single recv()
+    can short-read a split handshake, and a short-read misjudging a
+    LIVE peer as confirmed-dead shrinks the fail-over electorate toward
+    split-brain, so the caller classifies on the COMPLETE prefix."""
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = conn.recv(n - len(buf))
+        except OSError:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+class ProbeRing(object):
+    """Peer-to-peer TCP liveness listener, INDEPENDENT of the
+    coordination service: every coordinator runs one and publishes its
+    port in the generation's membership record, so when the KV control
+    plane goes dark the survivors can still tell "the leader's host
+    died" apart from "I am partitioned" — and a healthy majority
+    recovers in place instead of draining for a job restart."""
+
+    def __init__(self, port: int = 0):
+        import socket
+        import threading
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("", port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve,
+                                        name="mxpod-probe-ring",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        import socket
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)   # wake a blocked accept
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                if _recv_exact(conn, len(_PROBE_Q)) == _PROBE_Q:
+                    conn.sendall(_PROBE_A)
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+
+def probe_peer(address: Optional[str],
+               timeout: Optional[float] = None) -> str:
+    """One liveness probe: ``"live"`` (the peer's probe ring answered),
+    ``"dead"`` (its host's TCP stack POSITIVELY refused — the
+    coordinator process is gone but the machine answers, e.g. SIGKILL),
+    or ``"unreachable"`` (timeout / no route: a dead machine and a
+    network partition look identical, so the caller must treat it as
+    AMBIGUOUS — the majority arithmetic in the pod coordinator counts
+    live vs. everything-not-positively-dead)."""
+    import socket
+    if not address or address.rpartition(":")[2] in ("", "0"):
+        return "unreachable"
+    if timeout is None:
+        from .. import config as _config
+        timeout = float(_config.get("MXNET_TPU_PROBE_TIMEOUT"))
+    host, _, port = address.rpartition(":")
+    try:
+        conn = socket.create_connection((host or "127.0.0.1", int(port)),
+                                        timeout=timeout)
+    except ConnectionRefusedError:
+        return "dead"
+    except OSError:
+        return "unreachable"
+    try:
+        conn.settimeout(timeout)
+        conn.sendall(_PROBE_Q)
+        reply = _recv_exact(conn, len(_PROBE_A))
+    except OSError:
+        return "unreachable"
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+    if reply == _PROBE_A:
+        return "live"
+    if reply and not _PROBE_A.startswith(reply):
+        # a recycled port ACTIVELY speaking another protocol is NOT our
+        # coordinator: positively dead
+        return "dead"
+    # silence or a partial prefix (slow peer, split segment): ambiguous —
+    # never confirmed-dead on an incomplete handshake
+    return "unreachable"
+
+
+def elect_leader(live: Iterable[int]) -> int:
+    """The deterministic election: lowest live rank. Every survivor
+    computes it from the SAME generation record + probe results, so no
+    communication is needed to agree (and none is available — the
+    election runs exactly when the control plane is dark)."""
+    return min(live)

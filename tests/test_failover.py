@@ -1,0 +1,501 @@
+"""Leader fail-over (ISSUE 12): the probe ring, the re-hostable PodKV
+control plane, deterministic election, partition adjudication, the
+dist.kv fault site's bounded retry, the successor finalize/abort of a
+mid-commit-orphaned pod save, and the heartbeat/monotonic-clock edge
+cases the liveness math must honor.
+
+The end-to-end 3-host drills (leader-kill, cascade, coordsvc) live in
+tools/pod_smoke.py (CI ``multihost`` job); these are the unit-level
+contracts every piece keeps on its own.
+"""
+import json
+import os
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import config as mx_config
+from mxnet_tpu import faults, profiler
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.checkpoint import format as ckpt_format
+from mxnet_tpu.checkpoint import (finalize_staged_pod_saves,
+                                  list_checkpoints, load_latest,
+                                  read_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_dist_state():
+    dist.reset_liveness()
+    yield
+    dist.heartbeat_stop()
+    dist.set_kv_backend(None)
+    dist.reset_liveness()
+    faults.clear()
+
+
+# ------------------------------------------------------------ probe ring
+
+def test_probe_ring_statuses():
+    ring = dist.ProbeRing()
+    try:
+        assert dist.probe_peer("127.0.0.1:%d" % ring.port,
+                               timeout=2.0) == "live"
+    finally:
+        ring.stop()
+    time.sleep(0.05)
+    # the listener is gone but the machine answers: POSITIVELY dead
+    assert dist.probe_peer("127.0.0.1:%d" % ring.port,
+                           timeout=2.0) == "dead"
+    # no route / timeout: ambiguous — dead host and partition look alike
+    assert dist.probe_peer("10.255.255.1:19999", timeout=0.2) \
+        == "unreachable"
+    # an unpublished port can never be probed
+    assert dist.probe_peer(None) == "unreachable"
+    assert dist.probe_peer("h:0") == "unreachable"
+
+
+def test_probe_rejects_recycled_port():
+    """A foreign service answering the probe port is NOT our
+    coordinator: a wrong banner reads as dead, not live."""
+    srv = dist.PodKVServer()      # speaks KV, not the probe magic
+    try:
+        assert dist.probe_peer("127.0.0.1:%d" % srv.port,
+                               timeout=2.0) == "dead"
+    finally:
+        srv.stop()
+
+
+def test_elect_leader_is_lowest_live():
+    assert dist.elect_leader([2, 1, 5]) == 1
+    assert dist.elect_leader({3}) == 3
+
+
+# -------------------------------------------------------- PodKV service
+
+def test_podkv_set_get_and_blocking_wait():
+    import threading
+    srv = dist.PodKVServer()
+    cli = dist.PodKVClient("127.0.0.1:%d" % srv.port)
+    try:
+        assert cli.ping(2.0)
+        cli.set("mxpod/k", json.dumps({"a": 1}))
+        assert json.loads(cli.get("mxpod/k", 500)) == {"a": 1}
+        assert cli.get("absent", 200) is None
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(cli.get("later", 5000)))
+        t.start()
+        time.sleep(0.2)
+        cli.set("later", "v")
+        t.join(10.0)
+        assert got == ["v"]
+    finally:
+        srv.stop()
+    time.sleep(0.05)
+    # a dead server: GET degrades to None (reads as a dead rank), SET
+    # raises (the caller's bounded retry owns the policy)
+    assert cli.get("mxpod/k", 200) is None
+    with pytest.raises(OSError):
+        cli.set("x", "y")
+
+
+def test_podkv_backend_drives_heartbeats_and_dead_ranks():
+    srv = dist.PodKVServer()
+    cli = dist.PodKVClient("127.0.0.1:%d" % srv.port)
+    try:
+        dist.set_kv_backend(cli)
+        assert dist.heartbeat_start(period=0.05, as_rank=3)
+        deadline = time.monotonic() + 5.0
+        while dist.dead_ranks(stale_after=10.0, ranks=[3]) == [3]:
+            assert time.monotonic() < deadline, "beat never landed"
+            time.sleep(0.05)
+        # an unknown rank never beat: dead immediately
+        assert dist.dead_ranks(stale_after=10.0, ranks=[3, 9]) == [9]
+    finally:
+        dist.heartbeat_stop()
+        srv.stop()
+        dist.set_kv_backend(None)
+
+
+# ---------------------------------------------------- dist.kv fault site
+
+class _RecordingKV(object):
+    def __init__(self):
+        self.sets = []
+        self.store = {}
+
+    def set(self, key, value):
+        self.sets.append(key)
+        self.store[key] = value
+
+    def get(self, key, timeout_ms):
+        return self.store.get(key)
+
+
+def test_kv_set_retries_injected_flake_then_succeeds():
+    """The satellite contract: bounded-retry on KV flakes is PROVABLE —
+    one injected EINTR costs exactly one dist_kv_retry and the write
+    still lands."""
+    backend = _RecordingKV()
+    dist.set_kv_backend(backend)
+    base = profiler.get_counter("dist_kv_retry")
+    faults.install("dist.kv@1:eintr")
+    dist.kv_set("k", "v")
+    assert backend.store["k"] == "v"
+    assert profiler.get_counter("dist_kv_retry") == base + 1
+
+
+def test_kv_get_retries_injected_flake_then_succeeds():
+    backend = _RecordingKV()
+    backend.store["k"] = "v"
+    dist.set_kv_backend(backend)
+    base = profiler.get_counter("dist_kv_retry")
+    faults.install("dist.kv@1:raise")
+    assert dist.kv_get("k", 100) == "v"
+    assert profiler.get_counter("dist_kv_retry") == base + 1
+
+
+def test_kv_flake_budget_is_bounded(monkeypatch):
+    """A persistent flake exhausts MXNET_TPU_KV_RETRIES and propagates —
+    never an unbounded retry loop."""
+    monkeypatch.setenv("MXNET_TPU_KV_RETRIES", "2")
+    backend = _RecordingKV()
+    dist.set_kv_backend(backend)
+    base = profiler.get_counter("dist_kv_retry")
+    faults.install("dist.kv:raise")          # EVERY arrival flakes
+    with pytest.raises(faults.FaultInjected):
+        dist.kv_set("k", "v")
+    assert profiler.get_counter("dist_kv_retry") == base + 2
+    assert backend.sets == []               # the write never went through
+
+
+def test_kv_get_absent_key_is_not_a_flake():
+    backend = _RecordingKV()
+    dist.set_kv_backend(backend)
+    base = profiler.get_counter("dist_kv_retry")
+    assert dist.kv_get("absent", 50) is None
+    assert profiler.get_counter("dist_kv_retry") == base
+
+
+# ------------------------------------------------- partition adjudication
+
+def _coordinator(monkeypatch, rank, world):
+    from mxnet_tpu.elastic import PodCoordinator
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9999")
+    monkeypatch.setenv("DMLC_NUM_WORKER", str(world))
+    monkeypatch.setenv("DMLC_WORKER_ID", str(rank))
+    return PodCoordinator(["true"], stale_after=0.5,
+                          rendezvous_window=0.5)
+
+
+def _patch_probes(monkeypatch, statuses):
+    """statuses: probe address -> live|dead|unreachable."""
+    monkeypatch.setattr(
+        dist, "probe_peer",
+        lambda addr, timeout=None: statuses.get(addr, "unreachable"))
+
+
+def test_adjudicate_majority_recovers_in_place(monkeypatch):
+    """The satellite fix: dead_ranks() reporting EVERY member must no
+    longer read as "I am partitioned" when the probe ring shows a
+    healthy majority — the pod fails over instead of dying."""
+    coord = _coordinator(monkeypatch, 1, 3)
+    coord.peer_info = {0: {"host": "h0", "probe": 70},
+                       1: {"host": "h1", "probe": 71},
+                       2: {"host": "h2", "probe": 72}}
+    _patch_probes(monkeypatch, {"h0:70": "dead", "h2:72": "live"})
+    assert coord._adjudicate([0, 1, 2]) == "leader-lost"
+    assert coord._failover_live == [1, 2]
+
+
+def test_adjudicate_minority_partition_exits(monkeypatch):
+    """...and a true minority partition (peers unreachable, not
+    positively dead) still drains for a job restart."""
+    coord = _coordinator(monkeypatch, 1, 3)
+    coord.peer_info = {0: {"host": "h0", "probe": 70},
+                       1: {"host": "h1", "probe": 71},
+                       2: {"host": "h2", "probe": 72}}
+    _patch_probes(monkeypatch, {})           # everything times out
+    assert coord._adjudicate([0, 1, 2]) == "control-plane-lost"
+
+
+def test_adjudicate_confirmed_dead_shrinks_electorate(monkeypatch):
+    """The cascade shape: a 2-member pod whose leader is POSITIVELY
+    dead (connection refused) leaves a 1-member electorate — the lone
+    survivor may continue at world 1. An UNREACHABLE leader (could be
+    a partition) must not."""
+    coord = _coordinator(monkeypatch, 2, 3)
+    coord.members = [1, 2]
+    coord.peer_info = {1: {"host": "h1", "probe": 71},
+                       2: {"host": "h2", "probe": 72}}
+    _patch_probes(monkeypatch, {"h1:71": "dead"})
+    assert coord._adjudicate([1, 2]) == "leader-lost"
+    assert coord._failover_live == [2]
+    _patch_probes(monkeypatch, {"h1:71": "unreachable"})
+    assert coord._adjudicate([1, 2]) == "control-plane-lost"
+
+
+def test_failover_rehosts_on_elected_survivor(monkeypatch):
+    """A real (single-process) fail-over: the elected leader binds its
+    published fail-over port, heartbeats restart on the new control
+    plane, membership shrinks to the survivors, and the counters/gauge
+    record the election."""
+    coord = _coordinator(monkeypatch, 1, 3)
+    port = dist.free_port()
+    coord.peer_info = {1: {"host": "127.0.0.1", "probe": 0,
+                           "failover": port}}
+    coord._failover_live = [1]
+    base = profiler.get_counter("elastic_leader_failover")
+    try:
+        assert coord._failover()
+        assert coord.members == [1]
+        assert coord.leader == 1
+        assert coord.cp_addr == "127.0.0.1:%d" % port
+        assert coord.leader_failovers == 1
+        assert profiler.get_counter("elastic_leader_failover") == base + 1
+        # the re-hosted control plane is real: a fresh client talks to it
+        cli = dist.PodKVClient(coord.cp_addr)
+        assert cli.ping(2.0)
+        # ...and our own heartbeat landed under the ORIGINAL pod rank
+        deadline = time.monotonic() + 5.0
+        while cli.get("mxnet_hb/1", 200) is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+    finally:
+        dist.heartbeat_stop()
+        if coord._kv_server is not None:
+            coord._kv_server.stop()
+        dist.set_kv_backend(None)
+
+
+def test_failover_fails_legibly_when_new_leader_never_comes_up(
+        monkeypatch):
+    """A follower whose elected leader dies mid-fail-over must give up
+    within the bootstrap window (→ exit 1 for a job restart), never
+    hang."""
+    coord = _coordinator(monkeypatch, 2, 3)
+    coord.bootstrap_timeout = 1.0
+    coord.peer_info = {1: {"host": "127.0.0.1", "probe": 0,
+                           "failover": dist.free_port()}}
+    coord._failover_live = [1, 2]
+    assert not coord._failover()
+
+
+def test_rendezvous_publishes_peer_info(monkeypatch):
+    """The generation record carries each member's host, probe port and
+    fail-over port — everything a later election needs with the control
+    plane dark."""
+    store = {}
+    monkeypatch.setattr(dist, "kv_set",
+                        lambda k, v: store.__setitem__(k, v))
+    monkeypatch.setattr(dist, "kv_get",
+                        lambda k, timeout_ms: store.get(k))
+    monkeypatch.setattr(dist, "dead_ranks", lambda **kw: [])
+    coord = _coordinator(monkeypatch, 0, 2)
+    store["mxpod/g0/join/1"] = json.dumps(
+        {"host": "h1", "probe": 71, "failover": 81})
+    rec = coord._rendezvous(0)
+    assert rec["ranks"] == [0, 1]
+    assert rec["peers"]["1"] == {"host": "h1", "probe": 71,
+                                 "failover": 81}
+    join0 = json.loads(store["mxpod/g0/join/0"])
+    assert set(join0) == {"host", "probe", "failover"}
+    assert coord.peer_info[1]["failover"] == 81
+    assert coord.leader == 0
+
+
+# ------------------------------------- successor finalize / abort matrix
+
+def _crc(arr):
+    return zlib.crc32(memoryview(np.ascontiguousarray(arr)).cast("B")) \
+        & 0xFFFFFFFF
+
+
+def _stage_pod_save(base, step, gen, ranks, world, w, meta=None):
+    """Hand-build a pod staging dir the way _write_checkpoint_pod leaves
+    it when the leader dies mid-commit: per-rank arrays + fsynced
+    record files, NO manifest."""
+    tmp = os.path.join(base, ".tmp-ckpt-%010d.pod.g%s" % (step, gen))
+    os.makedirs(tmp, exist_ok=True)
+    for r in ranks:
+        piece = w[r:r + 1]
+        fname = "arrays-p%d.npz" % r
+        with open(os.path.join(tmp, fname), "wb") as f:
+            np.savez(f, **{"w@p%d.s0" % r: piece})
+        rec = {"file": fname, "process_index": r, "world_size": world,
+               "size": os.path.getsize(os.path.join(tmp, fname)),
+               "arrays": {"w@p%d.s0" % r: {
+                   "shape": list(piece.shape), "dtype": str(piece.dtype),
+                   "crc32": _crc(piece), "nbytes": int(piece.nbytes)}},
+               "tensors": {"w": {
+                   "kind": "sharded", "shape": list(w.shape),
+                   "dtype": str(w.dtype), "mesh": {"data": world},
+                   "spec": "('data',)",
+                   "shards": [{"key": "w@p%d.s0" % r,
+                               "index": [[r, r + 1], None],
+                               "process_index": r}]}},
+               "meta": meta or {}}
+        with open(os.path.join(tmp, "record-p%d.json" % r), "w") as f:
+            json.dump(rec, f)
+    return tmp
+
+
+def test_successor_finalizes_complete_staging(tmp_path):
+    """Ordering (a): the leader died AFTER every shard record was
+    published — the successor commits exactly the manifest the leader
+    would have, provenance-tagged, and load_latest sees it."""
+    w = np.arange(8, dtype=np.float32).reshape(2, 4)
+    meta = {"step": 5, "loop": {"epoch": 2, "batches_done": 4}}
+    _stage_pod_save(str(tmp_path), 5, "1", [0, 1], 2, w, meta=meta)
+    out = finalize_staged_pod_saves(str(tmp_path), by_rank=1)
+    assert len(out) == 1 and out[0].endswith("ckpt-0000000005")
+    path, tensors, man = load_latest(str(tmp_path))
+    np.testing.assert_array_equal(tensors["w"], w)
+    assert man["meta"]["loop"] == {"epoch": 2, "batches_done": 4}
+    assert man["meta"]["pod_commit"] == {"committed_by": 1,
+                                         "path": "successor", "gen": "1"}
+    # idempotent: a second audit finds nothing left to do
+    assert finalize_staged_pod_saves(str(tmp_path)) == []
+
+
+def test_successor_aborts_incomplete_staging(tmp_path):
+    """Ordering (b): the leader died BEFORE its own record landed — the
+    successor must NOT commit (rank 0's windows would be missing) and
+    must leave the staging dir for GC; load_latest never sees a torn
+    manifest."""
+    w = np.arange(8, dtype=np.float32).reshape(2, 4)
+    ckpt_format.write_checkpoint(str(tmp_path), 4, {"w": w})
+    tmp = _stage_pod_save(str(tmp_path), 5, "1", [1], 2, w)
+    assert finalize_staged_pod_saves(str(tmp_path)) == []
+    assert os.path.isdir(tmp)                  # left for GC
+    path, _t, _m = load_latest(str(tmp_path))
+    assert path.endswith("ckpt-0000000004")    # fell back, not torn
+    assert [s for s, _p in list_checkpoints(str(tmp_path))] == [4]
+
+
+def test_successor_aborts_size_mismatched_shard(tmp_path):
+    w = np.arange(8, dtype=np.float32).reshape(2, 4)
+    tmp = _stage_pod_save(str(tmp_path), 6, "1", [0, 1], 2, w)
+    with open(os.path.join(tmp, "arrays-p1.npz"), "ab") as f:
+        f.write(b"junk")                      # size no longer matches
+    assert finalize_staged_pod_saves(str(tmp_path)) == []
+    assert os.path.isdir(tmp)
+
+
+def test_successor_skips_current_generation(tmp_path, monkeypatch):
+    """A staging dir of the CURRENT generation may be a live save in
+    flight: the audit must not race the real commit."""
+    w = np.arange(8, dtype=np.float32).reshape(2, 4)
+    tmp = _stage_pod_save(str(tmp_path), 7, "3", [0, 1], 2, w)
+    monkeypatch.setenv("MXNET_TPU_POD_GEN", "3")
+    assert finalize_staged_pod_saves(str(tmp_path)) == []
+    assert os.path.isdir(tmp)
+    monkeypatch.setenv("MXNET_TPU_POD_GEN", "4")
+    assert len(finalize_staged_pod_saves(str(tmp_path))) == 1
+
+
+def test_finalized_checkpoint_reads_like_any_other(tmp_path):
+    w = np.arange(8, dtype=np.float32).reshape(2, 4)
+    _stage_pod_save(str(tmp_path), 8, "2", [0, 1], 2, w)
+    finalize_staged_pod_saves(str(tmp_path))
+    path = os.path.join(str(tmp_path), "ckpt-0000000008")
+    assert ckpt_format.probe_valid(path)
+    tensors, man = read_checkpoint(path)
+    np.testing.assert_array_equal(tensors["w"], w)
+    assert man["world_size"] == 2
+
+
+# ------------------------------------------------ heartbeat edge cases
+
+class _FakeClient(object):
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.store:
+            raise KeyError(key)
+        return self.store[key]
+
+
+@pytest.fixture()
+def fake_pod(monkeypatch):
+    client = _FakeClient()
+    monkeypatch.setattr(dist, "_client", lambda: client)
+    monkeypatch.setattr(dist, "num_workers", lambda: 2)
+    monkeypatch.setattr(dist, "rank", lambda: 0)
+    return client
+
+
+def test_deadline_expiry_exactly_at_boundary(fake_pod, monkeypatch):
+    """Staleness is STRICT: a counter frozen for exactly stale_after
+    seconds is still live (the deadline has not *passed*); one tick more
+    and it is dead. The two-observation rule holds throughout."""
+    now = [50.0]
+    monkeypatch.setattr("time.monotonic", lambda: now[0])
+    fake_pod.store["mxnet_hb/0"] = "3"
+    fake_pod.store["mxnet_hb/1"] = "3"
+    assert dist.dead_ranks(stale_after=5.0, timeout_ms=10) == []
+    now[0] += 5.0                      # EXACTLY the deadline
+    fake_pod.store["mxnet_hb/0"] = "4"
+    assert dist.dead_ranks(stale_after=5.0, timeout_ms=10) == []
+    now[0] += 0.001                    # past it
+    fake_pod.store["mxnet_hb/0"] = "5"
+    assert dist.dead_ranks(stale_after=5.0, timeout_ms=10) == [1]
+
+
+def test_rejoin_racing_the_deadline(fake_pod, monkeypatch):
+    """A beat that advances in the same observation where the deadline
+    would have expired wins: the rank is live and the staleness window
+    re-arms from this observation."""
+    now = [10.0]
+    monkeypatch.setattr("time.monotonic", lambda: now[0])
+    fake_pod.store["mxnet_hb/0"] = "1"
+    fake_pod.store["mxnet_hb/1"] = "7"
+    assert dist.dead_ranks(stale_after=5.0, timeout_ms=10) == []
+    now[0] += 6.0                      # deadline passed...
+    fake_pod.store["mxnet_hb/0"] = "2"
+    fake_pod.store["mxnet_hb/1"] = "8"     # ...but the beat advanced
+    assert dist.dead_ranks(stale_after=5.0, timeout_ms=10) == []
+    now[0] += 6.0                      # frozen from HERE: dead now
+    fake_pod.store["mxnet_hb/0"] = "3"
+    assert dist.dead_ranks(stale_after=5.0, timeout_ms=10) == [1]
+
+
+def test_liveness_never_reads_the_wall_clock(fake_pod, monkeypatch):
+    """An NTP step must not expire deadlines or resurrect corpses: the
+    liveness math may only read time.monotonic(). time.time() is booby-
+    trapped for the duration."""
+    def _bomb():
+        raise AssertionError("liveness math read the wall clock")
+
+    monkeypatch.setattr("time.time", _bomb)
+    fake_pod.store["mxnet_hb/0"] = "1"
+    fake_pod.store["mxnet_hb/1"] = "1"
+    assert dist.dead_ranks(stale_after=5.0, timeout_ms=10) == []
+    dist.reset_liveness()
+
+
+def test_wall_clock_lint_holds_over_liveness_modules():
+    """The satellite wiring: the existing wall-clock lint rule runs over
+    parallel/dist.py + elastic.py — every deadline there must be
+    monotonic (the stall watchdog's st_mtime comparison carries an
+    explicit, justified allow)."""
+    from mxnet_tpu.analysis.lint import lint_paths
+    report = lint_paths([
+        os.path.join(REPO, "mxnet_tpu", "parallel", "dist.py"),
+        os.path.join(REPO, "mxnet_tpu", "elastic.py"),
+    ])
+    wall = [f for f in report.findings if f.code == "wall-clock"]
+    assert not wall, ["%s:%s %s" % (f.path, f.line, f.message)
+                      for f in wall]

@@ -1,0 +1,69 @@
+"""Non-finite step guard (``MXNET_TPU_NANCHECK``, ISSUE 12 satellite):
+a device-side isfinite reduction chained onto the fused step — zero
+host syncs during batches, one flag fetch at the epoch log boundary.
+
+The fires/stays-silent pair: a poisoned input must count
+``loop_nonfinite`` (warn) or raise naming the first non-finite output
+(abort); a clean run must move nothing; off must build nothing.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def _fit(mode, poison, num_epoch=2):
+    mx.config.set("MXNET_TPU_NANCHECK", mode)
+    try:
+        mx.random.seed(7)
+        X = np.random.RandomState(0).uniform(
+            -1, 1, (32, 8)).astype(np.float32)
+        if poison:
+            X[5, 3] = np.nan
+        Y = np.random.RandomState(1).uniform(
+            -1, 1, (32, 2)).astype(np.float32)
+        it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch_size=8)
+        sym = mx.sym.LinearRegressionOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                  name="fc"),
+            mx.sym.Variable("label"), name="reg")
+        mod = mx.mod.Module(sym, context=mx.cpu(),
+                            data_names=("data",), label_names=("label",))
+        mod.fit(it, num_epoch=num_epoch, eval_metric="mse",
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+        return mod
+    finally:
+        mx.config.reset("MXNET_TPU_NANCHECK")
+
+
+def test_clean_run_stays_silent():
+    base = profiler.get_counter("loop_nonfinite")
+    mod = _fit("warn", poison=False)
+    assert profiler.get_counter("loop_nonfinite") == base
+    # the guard existed (the chained reduction was built)...
+    assert mod._nancheck_fn is not None
+    # ...and left no pending flags after the final poll
+    assert mod._nan_flags is None
+
+
+def test_warn_counts_and_continues():
+    base = profiler.get_counter("loop_nonfinite")
+    _fit("warn", poison=True, num_epoch=2)     # completes despite NaNs
+    # flagged once per epoch boundary (the accumulator resets per epoch)
+    assert profiler.get_counter("loop_nonfinite") == base + 2
+
+
+def test_abort_raises_naming_the_output():
+    with pytest.raises(mx.MXNetError, match=r"reg_output.*NANCHECK"):
+        _fit("abort", poison=True)
+
+
+def test_off_builds_nothing():
+    base = profiler.get_counter("loop_nonfinite")
+    mod = _fit("off", poison=True)
+    assert profiler.get_counter("loop_nonfinite") == base
+    assert mod._nancheck_mode == "off"
+    assert mod._nancheck_fn is None
+    assert mod._nan_flags is None

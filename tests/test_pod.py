@@ -22,9 +22,12 @@ Five contracts under test:
   kvstore (update_on_kvstore) checkpoints and resumes bit-identically
   — the path every pod child uses.
 
-The end-to-end 2-host drill (host.die sigkill + wedge + child-kill,
-bit-identical params) is tools/pod_smoke.py, run by the slow test at
-the bottom and the CI ``multihost`` job.
+The end-to-end drills (2-host host.die sigkill + wedge + child-kill,
+3-host leader-kill / cascade / coordsvc fail-over, the mid-save
+leader-death matrix — all bit-identical params) are tools/pod_smoke.py,
+run by the slow test at the bottom and the CI ``multihost`` job; the
+fail-over unit contracts (probe ring, adjudication, election,
+successor finalize) are tests/test_failover.py.
 """
 import json
 import os
@@ -521,12 +524,14 @@ def test_monitor_terminated_delivers_preemption_notice(tmp_path,
 
 def test_monitor_control_plane_loss_is_not_self_death(tmp_path,
                                                       monkeypatch):
-    """Regression (review finding): when the coordination service
-    itself is unreachable (rank 0's host died), dead_ranks reports
-    EVERY rank — including the caller. A healthy follower must treat
-    that as the pod ending (drain, rc for a job restart), never as
-    evidence its own machine is broken (SELF_DEAD_RC asks the cluster
-    manager to replace the host)."""
+    """When the control plane is unreachable, dead_ranks reports EVERY
+    rank — including the caller. The monitor adjudicates over the probe
+    ring (ISSUE 12): here the peer is UNREACHABLE (no probe info — a
+    partition and a dead host look identical), so this side is a
+    1-of-2 minority and must end the pod with an rc for a JOB restart —
+    never SELF_DEAD_RC (nothing says this machine is broken), and never
+    a fail-over (a split-brain election from the minority side). The
+    majority/fail-over sides live in tests/test_failover.py."""
     monkeypatch.setattr(dist, "reset_liveness", lambda: None)
     monkeypatch.setattr(dist, "kv_set", lambda k, v: None)
     monkeypatch.setattr(dist, "kv_get", lambda k, timeout_ms: None)

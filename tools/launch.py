@@ -19,11 +19,17 @@ Usage:
 
 Pod mode (`--coordinated`): each worker becomes a per-host elastic
 coordinator (`python -m mxnet_tpu.elastic --coordinated -- cmd`) — the
-pod survives a host dying or wedging mid-run by draining, re-forming at
-the surviving world size, and resuming the training command from the
-newest complete checkpoint (docs/architecture/elastic.md):
+pod survives ANY host dying or wedging mid-run, including the host
+carrying the control plane (the survivors adjudicate over a
+peer-to-peer probe ring, elect the lowest live rank, and re-host the
+coordination KV service on its published fail-over port), by draining,
+re-forming at the surviving world size, and resuming the training
+command from the newest complete checkpoint
+(docs/architecture/elastic.md). Hosts advertise the address peers
+reach them at via MXNET_TPU_POD_HOST (defaults to the hostname; the
+pod drills pin 127.0.0.1):
 
-  python tools/launch.py -n 2 --coordinated -- python train.py
+  python tools/launch.py -n 3 --coordinated -- python train.py
 """
 import argparse
 import os

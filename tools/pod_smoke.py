@@ -1,13 +1,13 @@
 """Multi-host pod drill: coordinated elastic training that survives
-host death (CI ``multihost`` job; also driven by
-tests/test_pod.py::test_pod_smoke_script). Extends the single-process
-kill/reshard/resume drill of tools/elastic_smoke.py to a 2-HOST pod —
-two processes wired by the tools/launch.py DMLC env protocol, each
-running ``python -m mxnet_tpu.elastic --coordinated`` over a CPU
-backend (``JAX_PLATFORMS=cpu``), training data-parallel through the
-dist kvstore.
+ANY host death — including the leader's (CI ``multihost`` job; also
+driven by tests/test_pod.py::test_pod_smoke_script). Extends the
+single-process kill/reshard/resume drill of tools/elastic_smoke.py to
+a multi-HOST pod — processes wired by the tools/launch.py DMLC env
+protocol, each running ``python -m mxnet_tpu.elastic --coordinated``
+over a CPU backend (``JAX_PLATFORMS=cpu``), training data-parallel
+through the dist kvstore.
 
-Variants, all mid-epoch at a deterministic batch:
+2-host variants, all mid-epoch at a deterministic batch:
 
 * ``hostkill`` — ``host.die@K:hostkill`` SIGKILLs host 1's supervisor
   AND child (the whole "host" vanishes, no cleanup). The survivor
@@ -21,6 +21,22 @@ Variants, all mid-epoch at a deterministic batch:
 * ``sigkill-child`` — ``fit.batch@K:sigkill`` kills host 1's CHILD
   only (the supervisor survives): the pod must restart POD-WIDE at the
   same world (SPMD cannot restart one rank alone) and still finish.
+
+3-host LEADER fail-over variants (ISSUE 12 acceptance):
+
+* ``leader-kill`` — ``leader.die@K:hostkill`` on host 0, the one
+  carrying the control plane: survivors 1 and 2 adjudicate over the
+  probe ring, elect rank 1, re-host the KV control plane on its
+  published fail-over port, resume at world 2, and finish
+  bit-identical with ``elastic_leader_failover == 1``.
+* ``leader-cascade`` — kills the gen-0 leader AND then the gen-1
+  leader (rank 1): rank 2 alone fails over TWICE and finishes at
+  world 1 (``elastic_leader_failover == 2``).
+* ``coordsvc`` — ``leader.die@K:coordsvc`` kills ONLY the control-
+  plane KV service (every host stays up — the split-brain shape): all
+  three coordinators must adjudicate all-live over the probe ring,
+  re-elect rank 0, re-host on its fail-over port, and recover IN
+  PLACE at world 3 with zero dead hosts and zero reshards.
 
 Every variant's final parameters must be BIT-IDENTICAL to an
 uninterrupted 1-host-pod baseline, with zero steady-state recompiles
@@ -38,9 +54,18 @@ Also here:
   second save SIGKILLed mid-write on one host must abort as a unit
   (rank 0 times out, nothing commits) and ``load_latest`` falls back;
   the driver then reshards the survivor onto a single-device world.
+* mid-save LEADER death (both orderings): rank 0 SIGKILLed AFTER its
+  shard record published but BEFORE the manifest commit → a successor
+  deterministically FINALIZES the save from the file-backed records
+  (``finalize_staged_pod_saves``; ``meta.pod_commit.path ==
+  "successor"``); killed BEFORE its record → the successor provably
+  ABORTS (staging left for GC) and ``load_latest`` never sees a torn
+  manifest.
 * zero-cost gate: a plain single-process fit must never import
-  ``mxnet_tpu.parallel.dist``, arm the fault harness, or move any
-  ``elastic_*`` / ``fault_injected`` counter.
+  ``mxnet_tpu.parallel.dist`` (the probe ring and the fail-over
+  machinery live there), arm the fault harness, or move any
+  ``elastic_*`` / ``fault_injected`` / ``loop_nonfinite`` /
+  ``dist_kv_retry`` counter.
 
 Exit 0 + ``POD-DRILL-OK`` on success; any assertion kills CI. Every
 subprocess wait carries a hard timeout (PhaseGuard discipline — a
@@ -73,6 +98,11 @@ KNOBS = {
     "MXNET_TPU_ELASTIC_DRAIN_GRACE": "6",
     "MXNET_TPU_CKPT_POD_TIMEOUT": "8",
     "MXNET_TPU_DIST_TIMEOUT": "60",
+    "MXNET_TPU_PROBE_TIMEOUT": "1",
+    # every "host" of the drill is this machine: advertise a loopback
+    # address so a re-hosted control plane / probe ring is reachable
+    # (real clusters: the launcher exports each host's routable name)
+    "MXNET_TPU_POD_HOST": "127.0.0.1",
 }
 
 
@@ -116,9 +146,21 @@ def _pod_child(ckpt_dir, out_path):
     import mxnet_tpu as mx
     from mxnet_tpu import elastic, faults, profiler
     gen = int(os.environ.get("MXNET_TPU_POD_GEN", "0"))
+    wid = os.environ.get("DMLC_WORKER_ID", "")
     spec = os.environ.get("POD_SMOKE_FAULT", "")
-    if spec and gen == 0 and os.environ.get("DMLC_WORKER_ID") == "1":
+    if spec and gen == 0 and wid == "1":
         faults.install(spec)
+    # leader drills: semicolon list of g<gen>w<worker>=<spec> — the
+    # worker id is the GENERATION-renumbered one, so "g1w0" targets
+    # whoever leads the post-fail-over world (the cascade variant)
+    for item in os.environ.get("POD_SMOKE_FAULTS", "").split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        cond, _, fspec = item.partition("=")
+        g, _, w = cond.partition("w")
+        if int(g.lstrip("g")) == gen and w == wid:
+            faults.install(fspec)
     # the rendezvous must run before ANY device touch (backend pins the
     # process's device view) — so the kvstore comes before the seed
     kv = mx.kv.create("dist_sync")
@@ -129,9 +171,16 @@ def _pod_child(ckpt_dir, out_path):
     mod = mx.mod.Module(_symbol(), context=mx.cpu(),
                         data_names=("data",), label_names=("label",))
 
+    slp = float(os.environ.get("POD_SMOKE_BATCH_SLEEP", "0"))
+
     def _no_recompiles(_param):
         n = profiler.get_counter("loop_recompile")
         assert n == 0, "steady-state recompile detected (%d)" % n
+        if slp:
+            # coordsvc variant: the data plane survives the fault, so
+            # training must outlast the coordinators' dark-control-plane
+            # detection + drain — pace the batches like a real workload
+            time.sleep(slp)
 
     mod.fit(it, num_epoch=EPOCHS, eval_metric="mse", optimizer="sgd",
             optimizer_params={"learning_rate": 0.3, "momentum": 0.9,
@@ -239,8 +288,12 @@ def _zero_cost():
     from mxnet_tpu.checkpoint import pod_info
     assert pod_info() == (0, 1)
     for name in ("fault_injected", "elastic_restart", "elastic_reshard",
-                 "elastic_dead_host", "ckpt_preempt_save_failed"):
+                 "elastic_dead_host", "ckpt_preempt_save_failed",
+                 "elastic_leader_failover", "loop_nonfinite",
+                 "dist_kv_retry", "ckpt_pod_finalized"):
         assert profiler.get_counter(name) == 0, name
+    assert getattr(mod, "_nancheck_fn", None) is None, \
+        "NANCHECK=off must chain nothing onto the fused step"
     print("ZERO-COST-OK", flush=True)
     return 0
 
@@ -268,12 +321,12 @@ def _dmlc_env(base, rank, n, port):
 def _counters_line(stdout):
     m = re.search(r"POD-COORDINATOR-EXIT rank=(\d+) rc=(-?\d+) "
                   r"restarts=(\d+) reshards=(\d+) dead_hosts=(\d+) "
-                  r"counters=(\{.*\})", stdout)
+                  r"failovers=(\d+) counters=(\{.*\})", stdout)
     assert m, "no coordinator exit record in:\n%s" % stdout[-4000:]
     return {"rank": int(m.group(1)), "rc": int(m.group(2)),
             "restarts": int(m.group(3)), "reshards": int(m.group(4)),
-            "dead_hosts": int(m.group(5)),
-            "counters": json.loads(m.group(6))}
+            "dead_hosts": int(m.group(5)), "failovers": int(m.group(6)),
+            "counters": json.loads(m.group(7))}
 
 
 def _variant(name, fault, base_env, work, baseline, expect):
@@ -381,12 +434,205 @@ def _variant(name, fault, base_env, work, baseline, expect):
           flush=True)
 
 
+def _leader_variant(name, faults_spec, world, base_env, work, baseline,
+                    expect):
+    """One leader fail-over variant: a ``world``-host pod with
+    ``leader.die`` armed through the per-generation POD_SMOKE_FAULTS
+    map. Asserts exit codes per rank, the election/fail-over counters
+    from the survivors' exit records, the fault marker, and final
+    params bit-identical to the uninterrupted baseline."""
+    vdir = os.path.join(work, name)
+    os.makedirs(vdir)
+    ckpt = os.path.join(vdir, "ckpts")
+    out = os.path.join(vdir, "params.npz")
+    marker = os.path.join(vdir, "faults.touched")
+    port = _free_port()
+    env = dict(base_env)
+    env.update({"POD_SMOKE_FAULTS": faults_spec,
+                "MXNET_TPU_FAULTS_TOUCH": marker})
+    env.update(expect.get("env", {}))
+    # budget headroom: one leader loss can cost TWO restarts on a rank
+    # whose child died before its monitor saw the dark control plane
+    # (child crash + rendezvous fail-over both consume budget)
+    cmd = [sys.executable, "-m", "mxnet_tpu.elastic", "--coordinated",
+           "--max-restarts", "8", "--",
+           os.path.abspath(__file__), "--child", ckpt, out]
+    sups = [subprocess.Popen(cmd, env=_dmlc_env(env, r, world, port),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True,
+                             start_new_session=True)
+            for r in range(world)]
+    deadline = time.monotonic() + PHASE_TIMEOUT
+    outs = [None] * world
+    try:
+        # highest ranks outlive every fail-over: collect in reverse
+        # (rank 0 is the first to die in every leader variant)
+        for r in reversed(range(world)):
+            outs[r] = sups[r].communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+    except subprocess.TimeoutExpired:
+        for p in sups:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    p.kill()
+        raise AssertionError(
+            "%s: leader drill wedged past %.0fs" % (name, PHASE_TIMEOUT))
+    finally:
+        for p in sups:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    p.kill()
+                p.wait()
+
+    dump = "\n".join("--- rank %d rc=%s\n%s\n%s"
+                     % (i, p.returncode, (o or ("", ""))[0][-4000:],
+                        (o or ("", ""))[1][-4000:])
+                     for i, (p, o) in enumerate(zip(sups, outs)))
+    for r, want in expect["rc"].items():
+        assert sups[r].returncode in want, \
+            "%s: rank %d rc %s not in %s\n%s" \
+            % (name, r, sups[r].returncode, want, dump)
+    for r, want in expect["recs"].items():
+        rec = _counters_line(outs[r][0])
+        assert rec["failovers"] == want["failovers"], \
+            "%s: rank %d failovers %d != %d\n%s" \
+            % (name, r, rec["failovers"], want["failovers"], dump)
+        assert rec["counters"].get("elastic_leader_failover", 0) \
+            == want["failovers"], (name, r, rec["counters"], dump)
+        assert rec["restarts"] >= want.get("restarts_min", 0), (name, dump)
+        assert rec["reshards"] >= want.get("reshards_min", 0), (name, dump)
+        if "reshards_max" in want:
+            assert rec["reshards"] <= want["reshards_max"], (name, dump)
+        if "dead_hosts_max" in want:
+            assert rec["dead_hosts"] <= want["dead_hosts_max"], \
+                (name, dump)
+    with open(marker) as f:
+        touched = f.read()
+    for needle in expect["marker"]:
+        assert needle in touched, (name, needle, touched)
+
+    ref = dict(np.load(baseline))
+    got = dict(np.load(out))
+    assert set(ref) == set(got), (sorted(ref), sorted(got))
+    for k in sorted(ref):
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+    if expect.get("manifest_world"):
+        worlds = set()
+        for d in sorted(os.listdir(ckpt)):
+            mf = os.path.join(ckpt, d, "manifest.json")
+            if d.startswith("ckpt-") and os.path.exists(mf):
+                with open(mf) as f:
+                    worlds.add(json.load(f).get("world_size"))
+        assert expect["manifest_world"] in worlds, (worlds, dump)
+    print("POD-LEADER-VARIANT-OK %s (rcs=%s)"
+          % (name, [p.returncode for p in sups]), flush=True)
+
+
+# --------------------------------------- mid-save leader death drill
+
+def _ckpt_leader_child(ckpt_dir, mode):
+    """2-process pod: save 1 commits normally; during save 2 rank 0 is
+    SIGKILLed at the armed site (``after-record`` = between shard-
+    record publication and manifest commit; ``after-arrays`` = before
+    its record exists). Rank 1 must see the save abort as a unit — or
+    die with the data plane (the jax client's fatal abort over the
+    dead coordination service); both are the host-death shape. The
+    DRIVER is the successor that audits."""
+    import time as _t
+    from mxnet_tpu import faults
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.checkpoint import CheckpointPodError, write_checkpoint
+    dist.initialize()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    r, _world = dist.rank(), dist.num_workers()
+    if r == 0:
+        faults.install("ckpt.%s@2:sigkill" % mode.replace("-", "_"))
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    mesh = Mesh(np.array(devs), ("data",))
+    full = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    arr = jax.make_array_from_callback(
+        full.shape, NamedSharding(mesh, P("data", None)),
+        lambda idx: full[idx])
+    write_checkpoint(ckpt_dir, 1, {"w": arr}, meta={"step": 1})
+    if r == 1:
+        try:
+            write_checkpoint(ckpt_dir, 2, {"w": arr}, meta={"step": 2})
+        except CheckpointPodError:
+            pass                        # the unit abort — expected
+        print("POD-CKPT-LEADER-CHILD-OK rank=1", flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+    # rank 0: give rank 1 time to land its shard record FIRST (the
+    # successor audit distinguishes the orderings by which records are
+    # durable; a racing mid-write abort is the leave-for-GC case and is
+    # covered by the after-arrays ordering)
+    _t.sleep(1.5)
+    write_checkpoint(ckpt_dir, 2, {"w": arr}, meta={"step": 2})
+    raise AssertionError("rank 0 survived its injected SIGKILL")
+
+
+def _ckpt_leader_phase(work, base_env):
+    """Both orderings of the mid-save leader death, audited by the
+    driver as the successor leader."""
+    from mxnet_tpu.checkpoint import (finalize_staged_pod_saves,
+                                      list_checkpoints, load_latest)
+    full = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    for mode, expect_commit in (("after-record", True),
+                                ("after-arrays", False)):
+        cdir = os.path.join(work, "ckpt_leader_%s" % mode)
+        port = _free_port()
+        env = dict(base_env)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--ckpt-leader-child", cdir, mode],
+            env=_dmlc_env(env, r, 2, port), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for r in range(2)]
+        outs = [p.communicate(timeout=PHASE_TIMEOUT) for p in procs]
+        dump = "\n".join("--- rank %d rc=%s\n%s\n%s"
+                         % (i, p.returncode, o[-4000:], e[-4000:])
+                         for i, (p, (o, e)) in enumerate(zip(procs,
+                                                             outs)))
+        assert procs[0].returncode == -signal.SIGKILL, dump
+        # clean unit-abort, or the data-plane client's fatal abort over
+        # the dead coordination service — both are host-death shapes
+        assert procs[1].returncode in (0, -signal.SIGABRT), dump
+        steps = [s for s, _p in list_checkpoints(cdir)]
+        assert steps == [1], (mode, steps, dump)   # nothing partial
+        finalized = finalize_staged_pod_saves(cdir, by_rank=1)
+        if expect_commit:
+            assert len(finalized) == 1, (mode, finalized, dump)
+            _p2, tensors, man = load_latest(cdir)
+            assert man["step"] == 2, man["step"]
+            assert man["meta"]["pod_commit"]["path"] == "successor", \
+                man["meta"]["pod_commit"]
+            assert man["meta"]["pod_commit"]["committed_by"] == 1
+            np.testing.assert_array_equal(np.asarray(tensors["w"]), full)
+        else:
+            assert finalized == [], (mode, finalized, dump)
+            _p2, _t2, man = load_latest(cdir)
+            assert man["step"] == 1, man["step"]   # fell back, not torn
+            assert any(n.startswith(".tmp-ckpt-0000000002.pod")
+                       for n in os.listdir(cdir)), \
+                "aborted staging was not left for GC"
+        print("POD-CKPT-LEADER-OK %s" % mode, flush=True)
+
+
 def main():
     if "--child" in sys.argv:
         i = sys.argv.index("--child")
         return _pod_child(sys.argv[i + 1], sys.argv[i + 2])
     if "--ckpt-child" in sys.argv:
         return _ckpt_child(sys.argv[sys.argv.index("--ckpt-child") + 1])
+    if "--ckpt-leader-child" in sys.argv:
+        i = sys.argv.index("--ckpt-leader-child")
+        return _ckpt_leader_child(sys.argv[i + 1], sys.argv[i + 2])
     if "--baseline" in sys.argv:
         return _pod_child(*sys.argv[sys.argv.index("--baseline") + 1:][:2])
     if "--zero-cost" in sys.argv:
@@ -434,6 +680,48 @@ def main():
                 if attempt:
                     raise
                 print("POD-VARIANT-RETRY %s" % name, flush=True)
+
+    # ---- leader fail-over variants (3-host pod, ISSUE 12) ------------
+    CASCADE_AT = 5
+    leader_variants = [
+        ("leader-kill", "g0w0=leader.die@%d:hostkill" % DIE_AT, 3,
+         {"rc": {0: (-signal.SIGKILL,), 1: (0,), 2: (0,)},
+          "recs": {1: {"failovers": 1, "restarts_min": 1,
+                       "reshards_min": 1},
+                   2: {"failovers": 1, "restarts_min": 1,
+                       "reshards_min": 1}},
+          "marker": ["leader.die@%d:hostkill" % DIE_AT],
+          "manifest_world": 3}),
+        ("leader-cascade",
+         "g0w0=leader.die@%d:hostkill;g1w0=leader.die@%d:hostkill"
+         % (DIE_AT, CASCADE_AT), 3,
+         {"rc": {0: (-signal.SIGKILL,), 1: (-signal.SIGKILL,), 2: (0,)},
+          "recs": {2: {"failovers": 2, "restarts_min": 2,
+                       "reshards_min": 2}},
+          "marker": ["leader.die@%d:hostkill" % DIE_AT,
+                     "leader.die@%d:hostkill" % CASCADE_AT]}),
+        ("coordsvc", "g0w0=leader.die@%d:coordsvc" % DIE_AT, 3,
+         {"rc": {0: (0,), 1: (0,), 2: (0,)},
+          "recs": {r: {"failovers": 1, "restarts_min": 1,
+                       "reshards_max": 0, "dead_hosts_max": 0}
+                   for r in range(3)},
+          "marker": ["leader.die@%d:coordsvc" % DIE_AT],
+          "env": {"POD_SMOKE_BATCH_SLEEP": "0.3"}}),
+    ]
+    for name, spec, world, expect in leader_variants:
+        for attempt in range(2):
+            try:
+                _leader_variant(name, spec, world, base_env,
+                                os.path.join(work, "l%d" % attempt),
+                                baseline, expect)
+                break
+            except AssertionError:
+                if attempt:
+                    raise
+                print("POD-LEADER-VARIANT-RETRY %s" % name, flush=True)
+
+    # ---- mid-save leader death (successor finalize/abort) ------------
+    _ckpt_leader_phase(work, base_env)
 
     # ---- process-local sharded checkpoint phase ----------------------
     ckpt_dir = os.path.join(work, "sharded_ckpts")
