@@ -159,6 +159,16 @@ def _maybe_crash(point: str) -> None:
         _faults.fire("ckpt." + point, default_kind="sigkill")
 
 
+def _blackbox():
+    """The flight-recorder gate (one implementation:
+    ``profiler.blackbox`` — zero-import when the knob is off). The pod
+    commit phases recorded here (record published / manifest committed
+    / unit abort) are what the post-mortem CLI orders against a
+    mid-save death."""
+    from .. import profiler as _profiler
+    return _profiler.blackbox()
+
+
 def _crc32(arr: np.ndarray) -> int:
     arr = np.ascontiguousarray(arr)
     return zlib.crc32(memoryview(arr).cast("B")) & 0xFFFFFFFF
@@ -404,6 +414,15 @@ def _write_checkpoint_pod(base: str, step: int, tensors: Dict[str, Any],
             f.flush()
             os.fsync(f.fileno())
         _dist.kv_set("%s/p%d" % (kv_ns, rank), json.dumps(record))
+        _bb = _blackbox()
+        if _bb is not None:
+            # BEFORE the after_record crash point: a leader killed
+            # there must carry "my record published" as its last
+            # checkpoint event — the exact fact the successor-finalize
+            # audit turns on
+            _bb.record("ckpt", "record-published", step=step, gen=gen,
+                       rank=rank)
+            _bb.flush("ckpt-record")
         # the acceptance ordering drill: the leader dies AFTER its shard
         # record (file + KV) is published but BEFORE the manifest commit
         _maybe_crash("after_record")
@@ -478,7 +497,18 @@ def _write_checkpoint_pod(base: str, step: int, tensors: Dict[str, Any],
             shutil.rmtree(tmp, ignore_errors=True)
         _atomic.fsync_dir(base)
         _dist.kv_set("%s/commit" % kv_ns, final)
+        _bb = _blackbox()
+        if _bb is not None:
+            _bb.record("ckpt", "pod-manifest-commit", step=step,
+                       gen=gen, world=world)
         return final
+    except CheckpointPodError as exc:
+        _bb = _blackbox()
+        if _bb is not None:
+            _bb.record("ckpt", "pod-abort", step=step, gen=gen,
+                       error=str(exc)[:500])
+            _bb.flush("ckpt-pod-abort")
+        raise
     except BaseException:
         # do NOT rmtree the shared staging dir — peers' shard files live
         # in it, and a transient-error retry on this rank re-enters the
@@ -971,6 +1001,10 @@ def finalize_staged_pod_saves(base: str, by_rank: int = 0) -> List[str]:
                     raise               # lost to a concurrent finalizer?
             _atomic.fsync_dir(base)
             _profiler.incr_counter("ckpt_pod_finalized")
+            _bb = _blackbox()
+            if _bb is not None:
+                _bb.record("ckpt", "pod-finalized", step=step,
+                           gen=gen, by_rank=int(by_rank))
             log.warning("pod finalize: committed orphaned step-%d save "
                         "%s (original leader died mid-commit; finalized "
                         "by rank %d)", step, final, by_rank)

@@ -48,6 +48,15 @@ __all__ = [
 log = logging.getLogger(__name__)
 
 
+def _blackbox():
+    """The flight-recorder gate (one implementation:
+    ``profiler.blackbox`` — zero-import when the knob is off).
+    Checkpoint commit phases are post-mortem gold: "did the save land
+    before the host died" is the first question every recovery asks."""
+    from .. import profiler as _profiler
+    return _profiler.blackbox()
+
+
 # -------------------------------------------------- state-tree utilities
 
 def tree_encode(prefix: str, tree, tensors: Dict[str, Any],
@@ -376,6 +385,12 @@ class CheckpointManager(object):
         so the ``ckpt_sigterm`` counter is bumped here."""
         from .. import profiler as _profiler
         _profiler.incr_counter("ckpt_sigterm")
+        bb = _blackbox()
+        if bb is not None:
+            # observed-flag context (training thread), NOT the signal
+            # handler itself — the flag-only discipline holds
+            bb.record("ckpt", "preempt-save", epoch=epoch,
+                      batches_done=batches_done)
         self.wait()
         try:
             self.save_module(module, epoch=epoch,
@@ -443,6 +458,10 @@ class CheckpointManager(object):
             self._seq = max(self._seq + 1, step)
             step = self._seq
             meta["step"] = step
+        bb = _blackbox()
+        if bb is not None:
+            bb.record("ckpt", "save", step=step, epoch=epoch,
+                      batches_done=batches_done)
         self._submit(step, tensors, meta, t0, sync=sync)
         return step
 
@@ -502,6 +521,11 @@ class CheckpointManager(object):
                     self._last_error = exc
                 _profiler.incr_counter("ckpt_write_failed")
                 log.error("async checkpoint write failed: %s", exc)
+                bb = _blackbox()
+                if bb is not None:
+                    bb.record("ckpt", "write-failed",
+                              error=str(exc)[:500])
+                    bb.flush("ckpt-write-failed")
             finally:
                 # q.get() already removed the in-flight item, so qsize()
                 # IS the number of still-pending saves
@@ -558,6 +582,11 @@ class CheckpointManager(object):
         _profiler.incr_counter("ckpt_bytes", nbytes)
         _profiler.incr_counter("ckpt_write_us", write_us)
         _profiler.set_gauge("ckpt_last_write_ms", write_us / 1000.0)
+        bb = _blackbox()
+        if bb is not None:
+            bb.record("ckpt", "committed", step=step,
+                      write_ms=round(write_us / 1000.0, 1),
+                      bytes=nbytes)
 
     # --------------------------------------------------------- lifecycle
     def wait(self) -> None:

@@ -253,6 +253,31 @@ register("MXNET_TPU_OBS_PEAK_FLOPS", float, 0.0,
          "mx.obs: override the device's peak dense FLOP/s used for the "
          "obs_mfu gauge (0 = auto-detect by TPU device_kind; set "
          "explicitly on unknown devices or in tests)")
+register("MXNET_TPU_OBS_BLACKBOX", str, "",
+         "mx.obs flight recorder: directory the bounded in-memory event "
+         "ring (span closes, counter deltas, fault fires, pod "
+         "transitions, checkpoint commit phases) is flushed to as "
+         "blackbox-p<rank>.jsonl — periodically and at every terminal "
+         "moment (fault fire, SIGTERM/143, NANCHECK abort, watchdog "
+         "stall), so a killed host still leaves its last window on "
+         "disk. Merge with `python -m mxnet_tpu.obs blackbox <dir>`. "
+         "Empty = off (the recorder module is never imported)")
+register("MXNET_TPU_OBS_BLACKBOX_RING", int, 512,
+         "mx.obs flight recorder: events kept in the in-memory ring "
+         "(each flush rewrites the file with exactly this window, so "
+         "the on-disk artifact stays bounded at any run length)")
+register("MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS", float, 5.0,
+         "mx.obs flight recorder: heartbeat flush period in seconds — "
+         "the guarantee that a SIGKILL'd host still leaves a window no "
+         "older than this on disk; 0 = event-driven flushes only")
+register("MXNET_TPU_OBS_STRAGGLER_RATIO", float, 2.0,
+         "pod straggler detection: flag a rank when the fastest rank's "
+         "local work rate exceeds its by more than this factor "
+         "(per-rank step windows published to the coordination KV at "
+         "epoch log boundaries — zero extra per-step host syncs; the "
+         "leader aggregates into report()'s 'pod' block, the "
+         "obs_straggler counter and per-rank /metrics gauges). "
+         "0 = disabled (the straggler module is never imported)")
 def _parse_scan_layers(v) -> str:
     s = str(v).strip().lower()
     if s in ("", "0", "off", "false", "no", "none"):

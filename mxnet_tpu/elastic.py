@@ -110,6 +110,16 @@ __all__ = ["Supervisor", "PodCoordinator", "supervise", "resume_dir",
 
 log = logging.getLogger(__name__)
 
+
+def _blackbox():
+    """The flight-recorder gate (one implementation:
+    ``profiler.blackbox`` — zero-import when the knob is off).
+    Coordinator transitions (rendezvous, election, fail-over, drain,
+    stall) are exactly the events a post-mortem needs and exactly the
+    ones that die with the process, so they go through here."""
+    from . import profiler as _profiler
+    return _profiler.blackbox()
+
 _DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
@@ -444,9 +454,13 @@ class PodCoordinator(object):
         self.peer_info: dict = {}
         self.leader = 0
         self.cp_addr = self.coordinator
+        self.clock_offset = 0.0
         self._kv_server = None
         self._kv_client = None
         self._ring = None
+        self._bb = None
+        self._metrics = None
+        self._straggler_refresh = 0.0
         self._failover_live: Optional[List[int]] = None
         self._coordsvc_kill = False
         self._child: Optional[subprocess.Popen] = None
@@ -530,6 +544,68 @@ class PodCoordinator(object):
                   len(live), electorate)
         return "control-plane-lost"
 
+    def _start_metrics(self):
+        """Opt-in coordinator ``/metrics`` (``MXNET_TPU_OBS_METRICS_PORT``,
+        same knob the serve endpoint honors; -1 = off). A port conflict
+        — e.g. several drill coordinators on one machine with a fixed
+        port — degrades to no-endpoint with a warning, never a dead
+        supervisor."""
+        from . import config as _config
+        from . import profiler as _profiler
+        try:
+            port = int(_config.get("MXNET_TPU_OBS_METRICS_PORT"))
+        except (TypeError, ValueError):
+            return None
+        if port < 0:
+            return None
+        try:
+            from .obs.http import MetricsServer
+            srv = MetricsServer(port=port)
+        except OSError as exc:
+            _profiler.incr_counter("elastic_metrics_bind_failed")
+            log.warning("pod: /metrics endpoint could not bind port %d "
+                        "(%s); continuing without one", port, exc)
+            return None
+        log.info("pod: coordinator /metrics at %s", srv.url)
+        return srv
+
+    def _sync_clock(self) -> None:
+        """Estimate this host's wall-clock offset vs the control-plane
+        host (PodKV CLOCK exchange, min-RTT sample) for the flight
+        recorder's cross-host alignment; exported to the child via
+        ``MXNET_TPU_OBS_CLOCK_OFFSET``. Only runs when the recorder is
+        armed — the exchange is telemetry, not control."""
+        if self._bb is None:
+            return
+        off = 0.0
+        if self.rank != self.leader and self._kv_client is not None:
+            try:
+                off = self._kv_client.clock_offset() or 0.0
+            except Exception:                              # noqa: BLE001
+                off = 0.0
+        self.clock_offset = off
+        self._bb.set_clock_offset(off)
+
+    def _refresh_straggler_gauges(self, members) -> None:
+        """Leader-side: refresh the per-rank straggler gauges the
+        ``/metrics`` endpoint exposes, from the step windows the
+        training children publish to the control-plane KV. Bounded to
+        one sweep per ~2s and gated on the endpoint being up."""
+        from . import config as _config
+        if self._metrics is None or self.rank != self.leader:
+            return
+        now = time.monotonic()
+        if now - self._straggler_refresh < 2.0:
+            return
+        self._straggler_refresh = now
+        if float(_config.get("MXNET_TPU_OBS_STRAGGLER_RATIO")) <= 0:
+            return
+        try:
+            from .obs import straggler as _straggler
+            _straggler.refresh_gauges(len(members), gen=self._gen)
+        except Exception:                                  # noqa: BLE001
+            pass    # telemetry must never destabilize the monitor
+
     def _kill_control_plane(self) -> None:
         """The ``coordsvc`` fault kind (split-brain drill): abruptly
         stop the control-plane KV service this coordinator hosts while
@@ -564,6 +640,9 @@ class PodCoordinator(object):
                       "re-host the control plane", leader)
             return False
         addr = "%s:%d" % (host, port)
+        if self._bb is not None:
+            self._bb.record("pod", "elect", leader=leader,
+                            survivors=survivors, addr=addr)
         _dist.heartbeat_stop()
         _dist.reset_liveness()
         if self._kv_server is not None:     # old control plane, if ours
@@ -593,6 +672,10 @@ class PodCoordinator(object):
         _profiler.set_gauge("elastic_leader", leader)
         log.warning("pod: control plane re-hosted on rank %d (%s); "
                     "surviving members %s", leader, addr, survivors)
+        if self._bb is not None:
+            self._bb.record("pod", "failover", leader=leader, addr=addr,
+                            survivors=survivors)
+            self._bb.flush("failover")
         return True
 
     # ---------------------------------------------------------- rendezvous
@@ -690,6 +773,15 @@ class PodCoordinator(object):
             "MXNET_TPU_ELASTIC_COORDINATED": "1",
             "MXNET_TPU_ELASTIC_ATTEMPT": str(gen),
             "MXNET_TPU_ELASTIC_PROGRESS_FILE": self._progress_path,
+            # pod observability plumbing: the child's ORIGINAL pod rank
+            # (flight-recorder file naming — stable across generations),
+            # the control-plane KV address (straggler step windows
+            # publish there, readable by the supervisor and surviving
+            # child restarts), and this host's wall-clock offset vs the
+            # control plane (cross-host timeline alignment)
+            "MXNET_TPU_POD_RANK": str(self.rank),
+            "MXNET_TPU_POD_KV": self.cp_addr,
+            "MXNET_TPU_OBS_CLOCK_OFFSET": repr(self.clock_offset),
         })
         if gen > 0:
             env["MXNET_TPU_ELASTIC_RESUMED"] = "1"
@@ -759,6 +851,18 @@ class PodCoordinator(object):
         _dist.heartbeat_start(period=self.heartbeat_period,
                               as_rank=self.rank)
         _profiler.set_gauge("elastic_leader", 0)
+        self._bb = _blackbox()
+        if self._bb is not None:
+            self._bb.set_identity(rank=self.rank, role="coord")
+            self._bb.record("pod", "bootstrap", rank=self.rank,
+                            world=self.world,
+                            coordinator=self.coordinator)
+        # opt-in /metrics endpoint for the SUPERVISOR itself (the
+        # elastic_* counters + the leader's straggler gauges; training
+        # children expose their own through serve/user code): stdlib
+        # HTTP over the profiler registries — no jax backend is ever
+        # initialized in this process
+        self._metrics = self._start_metrics()
         self._workdir = tempfile.mkdtemp(prefix="mxpod_r%d_" % self.rank)
         restore_sig = self._install_forwarder()
         restore_usr1 = self._install_coordsvc_handler()
@@ -831,6 +935,13 @@ class PodCoordinator(object):
                               self.rank, gen, SELF_DEAD_RC)
                     _dist.heartbeat_stop()
                     return SELF_DEAD_RC
+                self._sync_clock()
+                if self._bb is not None:
+                    self._bb.record("pod", "rendezvous", gen=gen,
+                                    members=list(rec["ranks"]),
+                                    leader=self.leader,
+                                    clock_offset_s=self.clock_offset)
+                    self._bb.flush("rendezvous-g%d" % gen)
                 members = rec["ranks"]
                 world = len(members)
                 _profiler.set_gauge("elastic_world", world)
@@ -856,6 +967,10 @@ class PodCoordinator(object):
                 self._child = subprocess.Popen(self.argv, env=env)
                 outcome = self._monitor(members)
                 self._child = None
+                if self._bb is not None:
+                    self._bb.record("pod", "generation-end", gen=gen,
+                                    outcome=str(outcome))
+                    self._bb.flush("g%d-%s" % (gen, outcome))
                 if outcome == "done":
                     return 0
                 if outcome == "terminated":
@@ -895,6 +1010,11 @@ class PodCoordinator(object):
             _dist.heartbeat_stop()
             if self._ring is not None:
                 self._ring.stop()
+            if self._metrics is not None:
+                try:
+                    self._metrics.close()
+                except Exception:                          # noqa: BLE001
+                    pass
             if restore_sig is not None:
                 restore_sig()
             if restore_usr1 is not None:
@@ -991,6 +1111,8 @@ class PodCoordinator(object):
                     log.warning("pod: child died (%s)",
                                 "signal %d" % -rc if rc < 0
                                 else "exit %d" % rc)
+                if self._bb is not None:
+                    self._bb.record("pod", "child-exit", gen=gen, rc=rc)
                 try:
                     _dist.kv_set(restart_key,
                                  json.dumps({"rank": self.rank,
@@ -1006,7 +1128,10 @@ class PodCoordinator(object):
                 # abrupt service kill OUTSIDE the handler (flag-only
                 # handlers; the repo's signal-unsafe lint rule)
                 self._coordsvc_kill = False
+                if self._bb is not None:
+                    self._bb.record("pod", "coordsvc-kill", gen=gen)
                 self._kill_control_plane()
+            self._refresh_straggler_gauges(members)
             dead = self._dead_peers(members)
             if len(dead) >= len(members):
                 # EVERY member unreadable, ourselves included: the KV
@@ -1019,6 +1144,10 @@ class PodCoordinator(object):
                 dead = self._dead_peers(members)
                 if len(dead) >= len(members):
                     outcome = self._adjudicate(members)
+                    if self._bb is not None:
+                        self._bb.record("pod", "adjudicate", gen=gen,
+                                        outcome=outcome)
+                        self._bb.flush("adjudicate")
                     self._drain_child()
                     return outcome
             if self.rank in dead:
@@ -1037,6 +1166,11 @@ class PodCoordinator(object):
                             "the %.0fs deadline; draining for "
                             "re-rendezvous at the surviving world",
                             dead, self.stale_after)
+                if self._bb is not None:
+                    self._bb.record("pod", "dead-hosts", gen=gen,
+                                    ranks=dead)
+                    self._bb.record("pod", "drain", gen=gen)
+                    self._bb.flush("dead-hosts")
                 self._drain_child()
                 return "drained"
             try:
@@ -1068,6 +1202,12 @@ class PodCoordinator(object):
                     log.warning("pod: child progress stalled past "
                                 "%.0fs; requesting a pod-wide restart",
                                 self.stall_after)
+                    if self._bb is not None:
+                        # the watchdog-stall flush: a wedged child is a
+                        # post-mortem moment even though nothing died
+                        self._bb.record("pod", "stall", gen=gen,
+                                        stall_after=self.stall_after)
+                        self._bb.flush("stall")
                     try:
                         _dist.kv_set(restart_key, json.dumps(
                             {"rank": self.rank, "stall": True}))
@@ -1161,6 +1301,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              _profiler.counters().items()
                              if k.startswith("elastic")},
                             sort_keys=True)), flush=True)
+        bb = _blackbox()
+        if bb is not None:
+            # the coordinator's CLEAN-exit marker: the post-mortem CLI
+            # reads a final "exit" flush as "this rank did not die"
+            bb.record("pod", "exit", rc=rc, restarts=coord.restarts,
+                      failovers=coord.leader_failovers)
+            bb.flush("exit")
         sys.stdout.flush()
         sys.stderr.flush()
         # Exit order: the CURRENT leader (not necessarily rank 0 after a

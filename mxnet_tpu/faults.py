@@ -53,7 +53,11 @@ vanishes, the pod drill's node-loss model; ``wedge`` stops making
 progress while staying alive (the failure only a heartbeat deadline
 catches); ``coordsvc`` SIGUSR1s the coordinated supervisor, which
 abruptly stops the control-plane KV service it hosts while every host
-stays up — the split-brain shape only the probe ring can adjudicate.
+stays up — the split-brain shape only the probe ring can adjudicate;
+``slow`` sleeps ``MXNET_TPU_FAULTS_SLOW_SECS`` (default 0.25) and
+returns — the straggler shape: one rank's local work crawls while the
+pod stays healthy, detectable only by the per-rank step telemetry
+(``MXNET_TPU_OBS_STRAGGLER_RATIO``).
 
 Every fired fault bumps the ``fault_injected`` profiler counter (plus
 ``fault_injected.<site>``) *before* acting, and — when
@@ -78,7 +82,7 @@ ENV = "MXNET_TPU_FAULTS"
 LEGACY_ENV = "MXNET_TPU_CKPT_TEST_CRASH"
 
 KINDS = ("eio", "enospc", "eintr", "raise", "sigterm", "sigkill",
-         "bitflip", "truncate", "hostkill", "wedge", "coordsvc")
+         "bitflip", "truncate", "hostkill", "wedge", "coordsvc", "slow")
 
 # the shipped injection points (docs/architecture/elastic.md catalog).
 # A spec naming a site outside this set is accepted — new sites must be
@@ -234,6 +238,25 @@ def armed_or_env() -> bool:
     return ARMED
 
 
+def _blackbox_note(site: str, count: int, kind: str) -> None:
+    """Flight-recorder note BEFORE the fault acts: a kill-kind drill's
+    post-mortem must carry its own cause of death, and sigkill/hostkill
+    leave no later chance to flush. Runs at a normal call site, never a
+    signal handler (the signal-unsafe discipline); zero-import when the
+    recorder knob is off. ``slow`` fires every batch, so it records
+    without forcing a per-batch disk flush."""
+    try:
+        from . import profiler as _profiler
+        _bb = _profiler.blackbox()
+        if _bb is None:
+            return
+        _bb.record("fault", site, arrival=count, kind=kind)
+        if kind != "slow":
+            _bb.flush("fault:%s@%d:%s" % (site, count, kind))
+    except Exception:                                      # noqa: BLE001
+        pass    # the recorder must never change drill behavior
+
+
 def _corrupt_file(path: str, kind: str) -> None:
     try:
         size = os.path.getsize(path)
@@ -279,6 +302,7 @@ def fire(site: str, path: Optional[str] = None,
     from . import profiler as _profiler
     _profiler.incr_counter("fault_injected")
     _profiler.incr_counter("fault_injected.%s" % site)
+    _blackbox_note(site, count, kind)
     marker = os.environ.get(MARKER_ENV)
     if marker:
         # parent-readable trace BEFORE acting: even a hostkill/SIGKILL
@@ -345,6 +369,18 @@ def fire(site: str, path: Optional[str] = None,
                 pass
         while True:
             time.sleep(3600)
+    if kind == "slow":
+        # the straggler shape: this rank's local work crawls while the
+        # pod stays alive and healthy — nothing crashes, nothing stalls
+        # past a deadline; only the per-rank step telemetry can see it
+        import time
+        try:
+            delay = float(os.environ.get("MXNET_TPU_FAULTS_SLOW_SECS",
+                                         "0.25"))
+        except ValueError:
+            delay = 0.25
+        time.sleep(max(0.0, delay))
+        return
     if kind in ("bitflip", "truncate"):
         if path is None:
             raise FaultInjected(

@@ -21,6 +21,13 @@ from .. import ndarray as nd
 from .. import profiler as _profiler
 from ..model import BatchEndParam
 
+
+# the flight-recorder gate (one implementation: profiler.blackbox —
+# zero-import when the knob is off). fit() records only at terminal
+# moments (preemption, NANCHECK abort) and epoch boundaries — never
+# per batch.
+_blackbox = _profiler.blackbox
+
 __all__ = ["BaseModule"]
 
 
@@ -442,6 +449,20 @@ class BaseModule(object):
             except OSError:
                 pass
 
+        # pod straggler telemetry (docs/architecture/observability.md):
+        # per-rank step windows published at the epoch log boundary —
+        # one KV write per window riding the metric_sync fetch, zero
+        # extra per-step host syncs. Gated so a plain single-process
+        # fit never imports the obs pod stack (zero-cost,
+        # subprocess-proven by the CI multihost job).
+        straggler = None
+        if (os.environ.get("MXNET_TPU_POD_KV")
+                or os.environ.get("DMLC_NUM_WORKER", "1")
+                not in ("", "0", "1")) \
+                and float(_config.get("MXNET_TPU_OBS_STRAGGLER_RATIO")) > 0:
+            from ..obs import straggler as _straggler_mod
+            straggler = _straggler_mod.FitPublisher.create()
+
         completed = False
         if ckpt_mgr is not None and ckpt_mgr.config.save_on_sigterm:
             uninstall_sigterm = ckpt_mgr.install_sigterm()
@@ -481,6 +502,10 @@ class BaseModule(object):
                         # nothing left to train, fall through to the
                         # epoch-end processing the interrupted run missed
                         end_of_batch = True
+                # the straggler window opens fresh per epoch: the
+                # epoch-boundary segment (drain/eval/ckpt) is shared
+                # pod work, not a rank-local signal
+                t_host_mark = None
                 while not end_of_batch:
                     if _faults.ARMED:
                         # deterministic preemption/crash drills: the
@@ -503,6 +528,20 @@ class BaseModule(object):
                         fid = _profiler.new_flow()
                     if monitor is not None:
                         monitor.tic()
+                    if straggler is not None:
+                        # LOCAL-work window = previous metric fetch →
+                        # this dispatch: the host-side inter-step
+                        # segment (fault sleeps, SIGSTOP pulses, input
+                        # fetch, callbacks) where a rank's OWN slowness
+                        # lands. Collective waits surface inside the
+                        # dispatch/metric regions (async dispatch
+                        # defers them to the next device sync), which
+                        # this window excludes — counting a peer-wait
+                        # as local work would equalize every rank's
+                        # rate and hide the straggler.
+                        _t_ds = time.perf_counter()
+                        if t_host_mark is not None:
+                            straggler.step(_t_ds - t_host_mark)
                     with _profiler.span("fused_step_dispatch", "step",
                                         flow=fid):
                         if fused is not None and monitor is None:
@@ -527,6 +566,8 @@ class BaseModule(object):
                                 _profiler.incr_counter("loop_host_sync")
                             self.update_metric(eval_metric,
                                                data_batch.label)
+                    if straggler is not None:
+                        t_host_mark = time.perf_counter()
                     try:
                         next_data_batch = next(data_iter)
                         self.prepare(next_data_batch)
@@ -566,6 +607,13 @@ class BaseModule(object):
                                 "SIGTERM: checkpoint saved at epoch %d "
                                 "batch %d; exiting with status 143",
                                 epoch, nbatch)
+                            bb = _blackbox()
+                            if bb is not None:
+                                # observed-flag context on the training
+                                # thread — never the signal handler
+                                bb.record("preempt", "sigterm",
+                                          epoch=epoch, batch=nbatch)
+                                bb.flush("sigterm")
                             raise SystemExit(143)
 
                 # epoch barrier: wait out in-flight steps so the epoch
@@ -575,6 +623,16 @@ class BaseModule(object):
                 # visible as a metric-lane span at the log boundary
                 with _profiler.span("metric_sync", "metric", lane="metric"):
                     name_values = eval_metric.get_name_value()
+                if straggler is not None:
+                    # the log boundary: the metric fetch just synced the
+                    # host, so the window publish adds no device sync —
+                    # and rank 0 aggregates the pod's windows here
+                    straggler.publish(epoch)
+                bb = _blackbox()
+                if bb is not None:
+                    bb.record("epoch", "end", epoch=epoch, batches=nbatch,
+                              metrics={n: round(float(v), 6)
+                                       for n, v in name_values})
                 for name, val in name_values:
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
                 toc = time.perf_counter()
@@ -595,6 +653,14 @@ class BaseModule(object):
                                "overflowing update)" % (bad, epoch,
                                                         nan_mode))
                         if nan_mode == "abort":
+                            bb = _blackbox()
+                            if bb is not None:
+                                # NANCHECK abort is a terminal moment:
+                                # the window must carry the diverged
+                                # output's name
+                                bb.record("nancheck", "abort",
+                                          output=str(bad), epoch=epoch)
+                                bb.flush("nancheck")
                             raise MXNetError(msg)
                         self.logger.warning(msg)
 
@@ -624,6 +690,10 @@ class BaseModule(object):
                         self.logger.warning(
                             "SIGTERM: checkpoint saved at end of epoch "
                             "%d; exiting with status 143", epoch)
+                        bb = _blackbox()
+                        if bb is not None:
+                            bb.record("preempt", "sigterm", epoch=epoch)
+                            bb.flush("sigterm")
                         raise SystemExit(143)
 
                 # after the FINAL epoch a wrapped iterator must not be

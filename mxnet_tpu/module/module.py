@@ -16,6 +16,7 @@ buffers so weights never leave HBM.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -712,6 +713,15 @@ class Module(BaseModule):
             # ONE reshard event per resume, however many dimensions
             # (device mesh, pod world) changed at once
             _profiler.incr_counter("elastic_reshard")
+        from .base_module import _blackbox
+        _bb = _blackbox()
+        if _bb is not None:
+            # the post-mortem's "where did the survivors pick up":
+            # which checkpoint, and whether the restore resharded
+            _bb.record("resume", os.path.basename(ckpt.path),
+                       step=ckpt.step, resharded=bool(resharded),
+                       saved_world=saved_world, cur_world=cur_world,
+                       saved_pod=saved_pod, cur_pod=cur_pod)
         opt_meta = ckpt.meta.get("optimizer") or {}
         kind = opt_meta.get("kind")
         if kind == "fused":

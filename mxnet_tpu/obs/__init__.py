@@ -53,8 +53,21 @@ __all__ = [
     "render_prometheus", "parse_prometheus", "pod_labels",
     "mfu", "peak_flops", "register_executor",
     "MetricsServer", "start_metrics_server",
-    "report",
+    "report", "blackbox", "straggler",
 ]
+
+
+def __getattr__(name):
+    # the pod observability layer stays zero-import until something
+    # actually arms it: the flight recorder and the straggler publisher
+    # are knob-gated at every call site, so the package must not drag
+    # them in (the CI multihost zero-cost gate asserts both absent
+    # after a plain fit)
+    if name in ("blackbox", "straggler"):
+        import importlib
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
 
 # the jax.monitoring compile listener is the always-on layer: installed
 # at package import, zero cost outside compiles
@@ -106,4 +119,12 @@ def report() -> Dict[str, Any]:
         # aggregation across the pod is explicit, never a collision
         out["process"] = {"process_index": int(labels["process_index"]),
                           "world_size": int(labels["world_size"])}
+    if "mxnet_tpu.obs.straggler" in sys.modules:
+        # the pod block: per-rank steps/s + work rates and the flagged
+        # stragglers, as of the leader's last log-boundary aggregation
+        # (lazy — never imports the pod stack into a plain process)
+        from . import straggler as _straggler
+        block = _straggler.pod_block()
+        if block is not None:
+            out["pod"] = block
     return out

@@ -138,13 +138,17 @@ def collect() -> List[Dict[str, Any]]:
 def _collect_locked() -> List[Dict[str, Any]]:
     with _reg_lock:
         refs = list(_executors)
+    live = [m for m in (ref() for ref in refs) if m is not None]
+    if not live:
+        # nothing to rate — and do NOT resolve the peak (jax.devices()
+        # would INITIALIZE a backend): a /metrics scrape of a process
+        # that never trains, e.g. the pod coordinator's endpoint, must
+        # stay backend-free
+        return []
     peak = peak_flops()
     out: List[Dict[str, Any]] = []
     best = None
-    for ref in refs:
-        mod = ref()
-        if mod is None:
-            continue
+    for mod in live:
         steps = int(getattr(mod, "_obs_steps", 0))
         rec: Dict[str, Any] = {
             "name": getattr(mod, "_obs_label", type(mod).__name__),

@@ -1,0 +1,291 @@
+"""Pod straggler / stall detection — federated per-rank step telemetry.
+
+Bulk-synchronous SPMD hides stragglers from naive wall-clock rates: one
+slow rank stalls EVERY rank's batch cadence (the fast ranks just spend
+the difference waiting on the cross-host reduction), so the pod's
+steps/s degrades with no signal pointing at the culprit. This module
+measures each rank's **host-side inter-step segment** — previous
+batch's metric fetch → this batch's dispatch, where a rank's OWN
+slowness lands (fault-injection sleeps, SIGSTOP pulses, input fetch,
+callbacks) while a peer-wait never does: under async dispatch the
+collective wait surfaces inside the dispatch/metric device syncs,
+which the window excludes — and publishes per-rank
+``(count, wall_s, work_s)`` windows to the
+coordination KV **at the epoch log boundary only** (one KV write per
+window, riding the existing ``metric_sync`` host fetch: zero extra
+per-step host syncs, zero recompiles — counter-gated by the tests).
+
+The leader (rank 0) aggregates every rank's latest window into the
+``report()`` ``"pod"`` block — per-rank steps/s and work rates, the
+slowest/fastest work-rate ratio — and flags ranks whose work rate falls
+more than ``MXNET_TPU_OBS_STRAGGLER_RATIO`` behind the fastest:
+``obs_straggler`` counts one per flagged rank per aggregation, and the
+per-rank gauges (``obs_pod_steps_per_sec_r<r>``, ``obs_pod_work_per_sec_r<r>``,
+``obs_pod_straggler_r<r>``, ``obs_pod_slow_fast_ratio``) surface on any
+``/metrics`` endpoint — including the pod COORDINATOR's, whose monitor
+refreshes them from the control-plane KV (the children of a coordinated
+pod publish through ``MXNET_TPU_POD_KV``, so the telemetry survives
+child restarts and is visible to the supervisor).
+
+Zero-cost gate: a plain single-process fit never imports this module —
+``fit`` only reaches for it when a pod channel exists (``MXNET_TPU_POD_KV``
+or a multi-worker DMLC env) AND the ratio knob is positive.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import config as _config
+from .. import profiler as _profiler
+
+__all__ = ["FitPublisher", "aggregate", "refresh_gauges", "pod_block",
+           "KEY_FMT"]
+
+log = logging.getLogger(__name__)
+
+# generation-scoped so a pod restart cannot aggregate a previous
+# generation's stale windows against fresh ones
+KEY_FMT = "mxobs/g%d/steps/%d"
+
+_block_lock = threading.Lock()
+_last_block: Optional[Dict[str, Any]] = None
+# ranks whose per-rank gauges this process has set: a rank that leaves
+# the pod (death, reshard to a smaller world) must have its gauges
+# zeroed on the next aggregation, or /metrics serves a permanent false
+# straggler alarm for a host that no longer exists
+_gauged_ranks: set = set()
+
+
+class _Channel(object):
+    """Where the windows live: the pod coordinator's control-plane KV
+    when ``MXNET_TPU_POD_KV`` names it (coordinated children — readable
+    by the supervisor, survives child restarts), else the process's own
+    coordination KV (``dist.kv_set``/``kv_get`` — plain launcher pods)."""
+
+    def __init__(self, addr: Optional[str]):
+        self._client = None
+        if addr:
+            from ..parallel import dist as _dist
+            self._client = _dist.PodKVClient(addr)
+
+    def set(self, key: str, value: str) -> None:
+        if self._client is not None:
+            self._client.set(key, value)
+        else:
+            from ..parallel import dist as _dist
+            _dist.kv_set(key, value)
+
+    def get(self, key: str, timeout_ms: int) -> Optional[str]:
+        if self._client is not None:
+            return self._client.get(key, timeout_ms)
+        from ..parallel import dist as _dist
+        return _dist.kv_get(key, timeout_ms)
+
+
+def _gen() -> int:
+    try:
+        return int(os.environ.get("MXNET_TPU_POD_GEN", "0") or 0)
+    except ValueError:
+        return 0
+
+
+class FitPublisher(object):
+    """Per-process step-window accumulator the fit loop drives.
+
+    ``step(work_s)`` is called once per batch with the LOCAL host-side
+    inter-step duration (previous metric fetch → this dispatch — see
+    the module docstring for why that segment is peer-wait-free); the
+    wall cadence accumulates from the call marks themselves. The first
+    batch of every window only sets the baseline (its compile/fill
+    time must not skew the rate). ``publish(epoch)`` writes the window
+    and — on rank 0 — aggregates."""
+
+    def __init__(self, rank: int, world: int, channel: _Channel,
+                 pod_rank: Optional[int] = None):
+        self.rank = int(rank)
+        self.world = int(world)
+        # the STABLE identity stragglers are reported under: the
+        # original pod rank when the coordinator exported it (DMLC
+        # ranks are generation-renumbered after a fail-over — flagging
+        # by them would point an operator at the wrong host; the
+        # flight-recorder files use the same original-rank naming)
+        self.pod_rank = int(rank if pod_rank is None else pod_rank)
+        self._chan = channel
+        self._count = 0
+        self._wall = 0.0
+        self._work = 0.0
+        self._last: Optional[float] = None
+
+    @classmethod
+    def create(cls) -> Optional["FitPublisher"]:
+        """The fit-loop gate: None unless straggler detection is on
+        (ratio knob > 0) and a pod with a telemetry channel is active."""
+        if float(_config.get("MXNET_TPU_OBS_STRAGGLER_RATIO")) <= 0:
+            return None
+        addr = os.environ.get("MXNET_TPU_POD_KV")
+        if addr:
+            try:
+                rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+                world = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+            except ValueError:
+                return None
+        else:
+            from ..checkpoint.format import pod_info
+            rank, world = pod_info()
+        if world <= 1:
+            return None
+        try:
+            pod_rank = int(os.environ.get("MXNET_TPU_POD_RANK", rank))
+        except ValueError:
+            pod_rank = rank
+        try:
+            return cls(rank, world, _Channel(addr), pod_rank=pod_rank)
+        except Exception:                                  # noqa: BLE001
+            log.debug("straggler telemetry unavailable", exc_info=True)
+            return None
+
+    def step(self, work_s: float) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._wall += now - self._last
+            self._work += float(work_s)
+            self._count += 1
+        self._last = now
+
+    def publish(self, epoch: int) -> None:
+        """One KV write per log boundary; rank 0 also aggregates. A dark
+        control plane (mid-fail-over) must never fail the fit loop."""
+        if self._count <= 0:
+            return
+        payload = {"rank": self.rank, "pod_rank": self.pod_rank,
+                   "epoch": int(epoch),
+                   "gen": _gen(), "count": self._count,
+                   "wall_s": round(self._wall, 6),
+                   "work_s": round(self._work, 6)}
+        try:
+            self._chan.set(KEY_FMT % (_gen(), self.rank),
+                           json.dumps(payload))
+        except Exception:                                  # noqa: BLE001
+            _profiler.incr_counter("obs_straggler_publish_failed")
+            log.debug("straggler window publish failed", exc_info=True)
+        self._count, self._wall, self._work = 0, 0.0, 0.0
+        self._last = None
+        if self.rank == 0:
+            try:
+                aggregate(self.world, reader=self._chan.get)
+            except Exception:                              # noqa: BLE001
+                log.debug("straggler aggregation failed", exc_info=True)
+
+
+def _read_windows(world: int, reader, timeout_ms: int,
+                  gen: Optional[int] = None) -> Dict[int, Dict[str, Any]]:
+    windows: Dict[int, Dict[str, Any]] = {}
+    gen = _gen() if gen is None else int(gen)
+    for r in range(world):
+        try:
+            raw = reader(KEY_FMT % (gen, r), timeout_ms)
+        except Exception:                                  # noqa: BLE001
+            raw = None
+        if raw is None:
+            continue
+        try:
+            windows[r] = json.loads(raw)
+        except ValueError:
+            continue
+    return windows
+
+
+def aggregate(world: int, reader, timeout_ms: int = 200,
+              gen: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """Leader-side rollup of every rank's latest window: per-rank
+    steps/s (wall cadence) and work rate (count / local work seconds),
+    the slowest/fastest work-rate ratio, and the flagged stragglers.
+    Sets the per-rank gauges, bumps ``obs_straggler`` once per flagged
+    rank, and stores the block ``mx.obs.report()`` attaches."""
+    global _last_block
+    ratio_knob = float(_config.get("MXNET_TPU_OBS_STRAGGLER_RATIO"))
+    windows = _read_windows(int(world), reader, int(timeout_ms), gen)
+    if not windows:
+        return None
+    ranks: Dict[str, Dict[str, Any]] = {}
+    rates: Dict[int, float] = {}
+    for slot, w in sorted(windows.items()):
+        # report under the STABLE pod rank the publisher recorded
+        # (generation-renumbered DMLC slots would point an operator at
+        # the wrong host after a fail-over); pre-pod_rank windows fall
+        # back to the slot
+        r = int(w.get("pod_rank", w.get("rank", slot)))
+        count = max(0, int(w.get("count", 0)))
+        wall = float(w.get("wall_s", 0.0))
+        work = float(w.get("work_s", 0.0))
+        steps_s = count / wall if count and wall > 0 else None
+        work_rate = count / work if count and work > 0 else None
+        ranks[str(r)] = {"epoch": w.get("epoch"), "steps": count,
+                         "steps_per_sec": round(steps_s, 3)
+                         if steps_s else None,
+                         "work_per_sec": round(work_rate, 3)
+                         if work_rate else None}
+        if steps_s:
+            _profiler.set_gauge("obs_pod_steps_per_sec_r%d" % r, steps_s)
+        if work_rate:
+            _profiler.set_gauge("obs_pod_work_per_sec_r%d" % r, work_rate)
+            rates[r] = work_rate
+    stragglers = []
+    ratio = None
+    if len(rates) >= 2:
+        fastest = max(rates.values())
+        slowest = min(rates.values())
+        ratio = fastest / slowest if slowest > 0 else None
+        _profiler.set_gauge("obs_pod_slow_fast_ratio", ratio or 0.0)
+        if ratio_knob > 0:
+            stragglers = sorted(r for r, rate in rates.items()
+                                if fastest / rate > ratio_knob)
+    for r in rates:
+        _profiler.set_gauge("obs_pod_straggler_r%d" % r,
+                            1.0 if r in stragglers else 0.0)
+    # a rank that left the pod must not keep serving its last gauges
+    # (a dead host flagged 1.0 forever is a permanent false alarm)
+    seen = set(rates) | {int(r) for r in ranks}
+    for r in sorted(_gauged_ranks - seen):
+        _profiler.set_gauge("obs_pod_straggler_r%d" % r, 0.0)
+        _profiler.set_gauge("obs_pod_steps_per_sec_r%d" % r, 0.0)
+        _profiler.set_gauge("obs_pod_work_per_sec_r%d" % r, 0.0)
+    _gauged_ranks.clear()
+    _gauged_ranks.update(seen)
+    if stragglers:
+        _profiler.incr_counter("obs_straggler", len(stragglers))
+        log.warning(
+            "pod stragglers: rank(s) %s more than %.1fx slower (local "
+            "work rate) than the fastest rank — check the host (IO "
+            "stalls, thermal throttle, noisy neighbor); per-rank rates: "
+            "%s", stragglers, ratio_knob,
+            {r: round(v, 3) for r, v in sorted(rates.items())})
+    block = {"ranks": ranks, "slow_fast_ratio": round(ratio, 3)
+             if ratio else None,
+             "stragglers": stragglers, "ratio_threshold": ratio_knob}
+    with _block_lock:
+        _last_block = block
+    return block
+
+
+def refresh_gauges(world: int, timeout_ms: int = 100,
+                   gen: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """Coordinator-side gauge refresh: read the windows from whatever KV
+    backend ``dist`` currently routes to (the pod coordinator's is its
+    control-plane PodKV client) — the opt-in ``/metrics`` endpoint then
+    exposes the leader's per-rank straggler view without the coordinator
+    ever touching a jax backend."""
+    from ..parallel import dist as _dist
+    return aggregate(world, reader=_dist.kv_get, timeout_ms=timeout_ms,
+                     gen=gen)
+
+
+def pod_block() -> Optional[Dict[str, Any]]:
+    """The last aggregation result (``mx.obs.report()["pod"]``)."""
+    with _block_lock:
+        return _last_block

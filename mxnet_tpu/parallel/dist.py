@@ -700,6 +700,9 @@ def kv_get(key: str, timeout_ms: int) -> Optional[str]:
 #   GET <key> <timeout_ms>   -> VAL <b64> | NONE   (server-side blocking
 #                               wait for the key, bounded by timeout_ms)
 #   PING                     -> PONG
+#   CLOCK                    -> CLK <wall_seconds>  (the flight-recorder
+#                               clock exchange: NTP-style offset
+#                               estimation against the leader's clock)
 
 _KV_MAGIC_PING = b"PING\n"
 _KV_MAGIC_PONG = b"PONG\n"
@@ -780,6 +783,13 @@ class PodKVServer(object):
                 op = parts[0]
                 if op == "PING":
                     conn.sendall(_KV_MAGIC_PONG)
+                elif op == "CLOCK":
+                    # WALL clock on purpose: the reply is compared
+                    # against the CALLER's wall clock to estimate the
+                    # cross-host offset the blackbox merger aligns on
+                    # (monotonic clocks have per-boot arbitrary zeros)
+                    conn.sendall(("CLK %r\n"
+                                  % time.time()).encode("ascii"))  # mx-lint: allow(wall-clock)
                 elif op == "SET" and len(parts) == 3:
                     with self._cond:
                         self._store[parts[1]] = parts[2]
@@ -883,6 +893,35 @@ class PodKVClient(object):
         if reply.startswith("VAL "):
             return _b64d(reply[4:])
         return None
+
+    def clock_offset(self, samples: int = 5) -> Optional[float]:
+        """NTP-style estimate of ``local_wall - server_wall``: each
+        sample brackets a CLOCK request between two local wall reads
+        and assumes the server stamped at the midpoint; the minimum-RTT
+        sample wins (its midpoint assumption has the tightest error
+        bound — half its RTT). None when the server never answered.
+
+        Wall clocks on BOTH ends on purpose — the whole point is to
+        compare wall clocks across hosts so the flight-recorder merger
+        can align per-host timelines; the RTT bound makes the jumpiness
+        of wall time measurable instead of hidden."""
+        import time
+        best = None
+        for _ in range(max(1, int(samples))):
+            t0 = time.time()     # mx-lint: allow(wall-clock)
+            reply = self._request("CLOCK\n", read_timeout=2.0)
+            t1 = time.time()     # mx-lint: allow(wall-clock)
+            if not reply or not reply.startswith("CLK "):
+                continue
+            try:
+                server = float(reply[4:])
+            except ValueError:
+                continue
+            rtt = t1 - t0
+            offset = (t0 + t1) / 2.0 - server
+            if best is None or rtt < best[0]:
+                best = (rtt, offset)
+        return None if best is None else best[1]
 
 
 # ------------------------------------------------- peer liveness probes

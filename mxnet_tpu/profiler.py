@@ -61,7 +61,7 @@ __all__ = [
     "counter_delta",
     "set_gauge", "get_gauge", "gauges", "reset_gauges",
     "span", "record_span", "spans_enabled", "new_flow",
-    "register_thread_lane",
+    "register_thread_lane", "set_span_listener", "blackbox",
     "Histogram", "histogram", "observe", "histograms", "reset_histograms",
 ]
 
@@ -206,6 +206,14 @@ def record_event(name: str, t_start: float, t_end: float,
 
 def _append_event(name, t_start, t_end, category, flow, lane,
                   count_span: bool = False) -> None:
+    listener = _span_listener
+    if listener is not None and count_span:
+        # outside _lock: the listener (flight recorder) may snapshot the
+        # counter table, which takes this module's lock itself
+        try:
+            listener(name, t_start, t_end, category, lane)
+        except Exception:                                  # noqa: BLE001
+            pass
     with _lock:
         # authoritative re-check under the lock: a concurrent
         # set_state("stop") + dump() must not observe a half-recorded
@@ -231,6 +239,37 @@ def _append_event(name, t_start, t_end, category, flow, lane,
 
 
 # --------------------------------------------------------------- spans
+
+# span-close listener (one consumer: the mx.obs.blackbox flight
+# recorder). When set, span() stays LIVE even while chrome-trace span
+# recording is off, so the recorder's bounded ring sees span closes
+# without the trace buffer growing; when None (the default) the shared
+# no-op fast path is untouched — the zero-cost contract holds.
+_span_listener = None
+
+
+def set_span_listener(fn) -> None:
+    """Install (``None`` removes) a callback invoked on every span close
+    as ``fn(name, t_start, t_end, category, lane)``. Exceptions are
+    swallowed — telemetry must never fail the traced code."""
+    global _span_listener
+    _span_listener = fn
+
+
+def blackbox():
+    """THE flight-recorder gate: the ``mx.obs.blackbox`` module iff
+    armed (``MXNET_TPU_OBS_BLACKBOX`` names a directory), else None.
+    Every hook site (fit loop, checkpoint writer, pod coordinator,
+    fault harness) routes through this one implementation so the
+    zero-import discipline — the recorder module never loads when the
+    knob is off, subprocess-proven by the CI ``multihost`` gate — is
+    maintained in exactly one place. Lives here, next to
+    :func:`set_span_listener` (the recorder's other hook), because
+    this module is jax-free and already imported by every caller."""
+    if not _config.get("MXNET_TPU_OBS_BLACKBOX"):
+        return None
+    from .obs import blackbox as _bb
+    return _bb
 
 
 def spans_enabled() -> bool:
@@ -297,7 +336,7 @@ def record_span(name: str, t_start: float, t_end: float,
     """Low-level span record for sites that time conditionally (e.g. the
     serve coalescer, which only emits when a batch actually formed).
     Same gating as :func:`span`."""
-    if not _spans_on:
+    if not _spans_on and _span_listener is None:
         return
     _append_event(name, t_start, t_end, category, flow, lane,
                   count_span=True)
@@ -310,9 +349,10 @@ def span(name: str, category: str = "span", flow: Optional[int] = None,
     ``flow`` links this slice to the other slices of the same batch or
     request across lanes; ``lane`` overrides the thread's lane with a
     named track. No-op (shared singleton, zero allocations) unless
-    :func:`spans_enabled`.
+    :func:`spans_enabled` or a span listener (the flight recorder) is
+    installed.
     """
-    if not _spans_on:
+    if not _spans_on and _span_listener is None:
         return _NOOP_SPAN
     return _Span(name, category, flow, lane)
 
@@ -589,6 +629,18 @@ def _dump_locked(finished: bool) -> str:
         if finished:
             _events.clear()
             _flows_seen.clear()
+    if path == "profile.json":
+        # shared-filesystem pods: every host dumping the DEFAULT
+        # filename would clobber the others' traces — suffix the pod
+        # rank (a pure state probe; an explicit set_config() filename
+        # is the user's choice and is respected as-is)
+        try:
+            from .checkpoint.format import pod_info
+            prank, pworld = pod_info()
+        except Exception:                                  # noqa: BLE001
+            prank, pworld = 0, 1
+        if pworld > 1:
+            path = "profile-p%d.json" % prank
     with open(path, "w") as f:
         json.dump(payload, f)
     return path

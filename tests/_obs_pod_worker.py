@@ -1,0 +1,86 @@
+"""Worker body for the pod observability tests (straggler detection +
+pod-suffixed profiler dumps). Run by tests/test_obs_pod.py in a 2-rank
+DMLC fake cluster; NOT collected by pytest.
+
+argv: <mode> <outdir>   mode in {"slow", "balanced"}
+
+Both ranks train the same tiny regression over the dist kvstore with a
+``fit.batch:slow`` fault armed on EVERY batch — ``balanced`` gives both
+ranks the same per-batch sleep (work rates equal, detection must stay
+silent), ``slow`` gives rank 1 a much larger one (rank 0's aggregation
+must flag it). Using the fault's sleep as the work floor makes the
+ratio deterministic instead of riding microsecond-scale fwd/bwd noise.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+BATCH, NSAMP, FEAT, OUT = 8, 64, 16, 4
+EPOCHS = 3
+
+
+def main():
+    mode, outdir = sys.argv[1], sys.argv[2]
+    os.chdir(outdir)
+    import mxnet_tpu as mx
+    from mxnet_tpu import faults, profiler
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    sleep = {"balanced": ("0.05", "0.05"),
+             "slow": ("0.05", "0.30")}[mode][min(rank, 1)]
+    os.environ["MXNET_TPU_FAULTS_SLOW_SECS"] = sleep
+    faults.install("fit.batch:slow")
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 2
+    mx.random.seed(7)
+    rng = np.random.RandomState(11)
+    X = rng.uniform(-1, 1, (NSAMP, FEAT)).astype(np.float32)
+    Y = rng.uniform(-1, 1, (NSAMP, OUT)).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch_size=BATCH)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=OUT, name="fc")
+    net = mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("label"))
+    mod = mx.mod.Module(net, context=mx.cpu(), data_names=("data",),
+                        label_names=("label",))
+    mod.fit(it, num_epoch=EPOCHS, eval_metric="mse", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05}, kvstore=kv)
+
+    # pod-suffixed profiler dump: the DEFAULT filename must not collide
+    # across ranks on a shared filesystem
+    profiler.set_state("run")
+    (mx.nd.ones((4, 4)) + 1).asnumpy()
+    profiler.set_state("stop")
+    dump_path = profiler.dump()
+
+    result = {
+        "rank": rank,
+        "mode": mode,
+        "dump": os.path.basename(dump_path),
+        "obs_straggler": profiler.get_counter("obs_straggler"),
+        "publish_failed": profiler.get_counter(
+            "obs_straggler_publish_failed"),
+        "gauges": {k: v for k, v in profiler.gauges().items()
+                   if k.startswith("obs_pod_")},
+    }
+    if rank == 0:
+        from mxnet_tpu.obs import straggler
+        result["block"] = straggler.pod_block()
+        result["report_pod"] = mx.obs.report().get("pod")
+    with open(os.path.join(outdir, "result-r%d.json" % rank), "w") as f:
+        json.dump(result, f)
+    kv.barrier()
+    print("OBS-POD-WORKER-DONE rank=%d" % rank, flush=True)
+
+
+if __name__ == "__main__":
+    main()

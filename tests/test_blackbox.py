@@ -1,0 +1,375 @@
+"""Flight recorder (ISSUE 13): bounded ring + crash-surviving flushes,
+the post-mortem merge CLI, and the lint discipline over the flush paths.
+
+The recorder's whole contract is "the telemetry survives the process",
+so most coverage here is subprocess drills: SIGTERM/143 preemption,
+an uncaught crash, a SIGKILL with only the periodic heartbeat flush to
+save the window, and the zero-import gate for plain fits.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, profiler as _profiler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    """Arm the recorder at tmp_path (no periodic thread); tear it down
+    fully so the span listener never leaks into other tests."""
+    from mxnet_tpu.obs import blackbox
+    mx.config.set("MXNET_TPU_OBS_BLACKBOX", str(tmp_path))
+    mx.config.set("MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS", "0")
+    try:
+        yield blackbox
+    finally:
+        blackbox.reset()
+        mx.config.reset("MXNET_TPU_OBS_BLACKBOX")
+        mx.config.reset("MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS")
+        faults.clear()
+
+
+def _read(path):
+    lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
+    header = json.loads(lines[0])
+    events = [json.loads(ln) for ln in lines[1:]]
+    return header, events
+
+
+def test_ring_is_bounded_and_flush_is_complete(recorder, tmp_path):
+    mx.config.set("MXNET_TPU_OBS_BLACKBOX_RING", 64)
+    try:
+        for i in range(200):
+            recorder.record("test", "ev%d" % i, i=i)
+        with _profiler.span("bb_span", "test"):
+            pass
+        _profiler.incr_counter("bb_unit_counter", 3)
+        path = recorder.flush("unit")
+        header, events = _read(path)
+        assert header["blackbox"] == 1
+        assert header["flush_reason"] == "unit"
+        assert header["rank"] == 0 and header["role"] == "proc"
+        assert "wall_base" in header and "clock_offset_s" in header
+        assert len(events) <= 64
+        names = [e["name"] for e in events if e["kind"] == "test"]
+        assert "ev199" in names and "ev0" not in names
+        # span closes land in the ring even with MXNET_TPU_OBS off
+        assert any(e["kind"] == "span" and e["name"] == "bb_span"
+                   for e in events)
+        # counter deltas ride each flush
+        delta = [e for e in events if e["kind"] == "counters"][-1]
+        assert delta["data"].get("bb_unit_counter") == 3
+        # events carry monotone wall timestamps
+        ts = [e["t"] for e in events]
+        assert ts == sorted(ts)
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_BLACKBOX_RING")
+
+
+def test_fault_fire_records_and_flushes(recorder, tmp_path):
+    faults.install("bb.site@1:raise")
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("bb.site")
+    path = recorder.path()
+    assert path is not None and os.path.exists(path)
+    header, events = _read(path)
+    assert header["flush_reason"] == "fault:bb.site@1:raise"
+    fault_evs = [e for e in events if e["kind"] == "fault"]
+    assert fault_evs and fault_evs[-1]["name"] == "bb.site"
+    assert fault_evs[-1]["data"] == {"arrival": 1, "kind": "raise"}
+    assert "bb.site@1:raise" in header["faults_armed"]
+
+
+def test_slow_fault_records_without_flushing(recorder, tmp_path,
+                                             monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FAULTS_SLOW_SECS", "0.01")
+    faults.install("bb.site:slow")
+    t0 = time.perf_counter()
+    faults.fire("bb.site")
+    assert time.perf_counter() - t0 >= 0.01
+    # recorded in the ring but no per-arrival disk flush
+    assert not os.path.exists(recorder.path())
+    path = recorder.flush("check")
+    _h, events = _read(path)
+    assert any(e["kind"] == "fault" and e["data"]["kind"] == "slow"
+               for e in events)
+
+
+def test_knob_off_is_zero_import_and_zero_cost():
+    """A plain fit must never import the recorder or the straggler
+    stack, and the flush counter must stay 0 (subprocess so this test
+    is immune to other tests having imported the modules)."""
+    code = """
+import sys
+import numpy as np
+import mxnet_tpu as mx
+X = np.random.RandomState(0).uniform(-1, 1, (32, 8)).astype("float32")
+Y = np.zeros((32, 1), "float32")
+it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch_size=8)
+net = mx.sym.LinearRegressionOutput(
+    mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=1),
+    mx.sym.Variable("label"))
+mod = mx.mod.Module(net, context=mx.cpu(), data_names=("data",),
+                    label_names=("label",))
+mod.fit(it, num_epoch=1, eval_metric="mse", optimizer="sgd")
+assert "mxnet_tpu.obs.blackbox" not in sys.modules
+assert "mxnet_tpu.obs.straggler" not in sys.modules
+from mxnet_tpu import profiler
+assert profiler.get_counter("obs_blackbox_flush") == 0
+assert profiler.get_counter("obs_straggler") == 0
+print("ZERO-IMPORT-OK")
+"""
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    for k in ("MXNET_TPU_OBS_BLACKBOX", "MXNET_TPU_FAULTS",
+              "MXNET_TPU_POD_KV"):
+        env.pop(k, None)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    assert "ZERO-IMPORT-OK" in proc.stdout
+
+
+def test_sigterm_preemption_leaves_window(tmp_path):
+    """The SIGTERM/143 protocol flushes the window from the training
+    thread (observed-flag discipline): the file must carry the preempt
+    event, the ckpt preempt-save phase, and the armed fault spec."""
+    bbdir = str(tmp_path / "bb")
+    code = """
+import numpy as np
+import mxnet_tpu as mx
+X = np.random.RandomState(0).uniform(-1, 1, (64, 8)).astype("float32")
+Y = np.zeros((64, 1), "float32")
+it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch_size=8)
+net = mx.sym.LinearRegressionOutput(
+    mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=1),
+    mx.sym.Variable("label"))
+mod = mx.mod.Module(net, context=mx.cpu(), data_names=("data",),
+                    label_names=("label",))
+mod.fit(it, num_epoch=4, eval_metric="mse", optimizer="sgd",
+        checkpoint=mx.checkpoint.CheckpointConfig(%r, every_n_batches=2))
+"""
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu",
+           "MXNET_TPU_OBS_BLACKBOX": bbdir,
+           "MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS": "0",
+           "MXNET_TPU_FAULTS": "fit.batch@5:sigterm"}
+    proc = subprocess.run(
+        [sys.executable, "-c", code % str(tmp_path / "ckpts")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 143, (proc.returncode,
+                                    proc.stderr[-3000:])
+    header, events = _read(os.path.join(bbdir, "blackbox-p0.jsonl"))
+    kinds = {(e["kind"], e["name"]) for e in events}
+    assert ("preempt", "sigterm") in kinds, sorted(kinds)
+    assert ("ckpt", "preempt-save") in kinds, sorted(kinds)
+    assert ("ckpt", "save") in kinds
+    assert ("fault", "fit.batch") in kinds
+    assert "fit.batch@5:sigterm" in header["faults_armed"]
+
+
+def test_crash_excepthook_flushes(tmp_path):
+    bbdir = str(tmp_path)
+    code = """
+import mxnet_tpu as mx
+from mxnet_tpu.obs import blackbox
+blackbox.record("unit", "before-crash")
+raise RuntimeError("boom for the recorder")
+"""
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu",
+           "MXNET_TPU_OBS_BLACKBOX": bbdir,
+           "MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS": "0"}
+    env.pop("MXNET_TPU_FAULTS", None)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 1
+    assert "boom for the recorder" in proc.stderr   # hook chains through
+    _header, events = _read(os.path.join(bbdir, "blackbox-p0.jsonl"))
+    crash = [e for e in events if e["kind"] == "crash"]
+    assert crash and "boom for the recorder" in crash[-1]["data"]["message"]
+    assert any(e["kind"] == "unit" for e in events)
+
+
+def test_periodic_heartbeat_survives_sigkill(tmp_path):
+    """The SIGKILL guarantee: no flush call ever runs, yet the last
+    periodic window must be on disk."""
+    bbdir = str(tmp_path)
+    code = """
+import time
+import mxnet_tpu as mx
+from mxnet_tpu.obs import blackbox
+blackbox.record("unit", "pre-kill", n=1)
+print("ARMED", flush=True)
+time.sleep(60)
+"""
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu",
+           "MXNET_TPU_OBS_BLACKBOX": bbdir,
+           "MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS": "0.2"}
+    env.pop("MXNET_TPU_FAULTS", None)
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.monotonic() + 120
+        path = os.path.join(bbdir, "blackbox-p0.jsonl")
+        while time.monotonic() < deadline and not os.path.exists(path):
+            time.sleep(0.1)
+        time.sleep(0.5)      # let at least one periodic flush land
+        assert os.path.exists(path), proc.communicate(timeout=30)
+    finally:
+        proc.kill()
+        proc.communicate()
+    header, events = _read(path)
+    assert header["flush_reason"] == "periodic"
+    assert any(e["kind"] == "unit" and e["name"] == "pre-kill"
+               for e in events)
+
+
+# ----------------------------------------------------- merge CLI
+
+
+def _write_rank_file(path, rank, role, reason, events, offset=0.0,
+                     armed=()):
+    header = {"blackbox": 1, "rank": rank, "role": role,
+              "flush_reason": reason, "clock_offset_s": offset,
+              "faults_armed": list(armed), "gen": 0,
+              "wall_base": 100.0, "perf_base": 0.0}
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _synthetic_pod(tmp_path):
+    d = str(tmp_path)
+    # rank 1 died at aligned t=110 (its wall runs +5s fast)
+    _write_rank_file(
+        os.path.join(d, "blackbox-p1.jsonl"), 1, "child",
+        "fault:host.die@12:hostkill",
+        [{"s": 1, "t": 114.0, "kind": "span", "name": "step",
+          "cat": "step", "dur_ms": 4.0},
+         {"s": 2, "t": 115.0, "kind": "fault", "name": "host.die",
+          "data": {"arrival": 12, "kind": "hostkill"}}],
+        offset=5.0, armed=["host.die@12:hostkill"])
+    # rank 0 survived: saw the death at 120, failed over at 125
+    _write_rank_file(
+        os.path.join(d, "blackbox-p0.jsonl"), 0, "child", "exit",
+        [{"s": 1, "t": 100.0, "kind": "epoch", "name": "end"}])
+    _write_rank_file(
+        os.path.join(d, "blackbox-p0-coord.jsonl"), 0, "coord", "exit",
+        [{"s": 1, "t": 120.0, "kind": "pod", "name": "dead-hosts",
+          "data": {"ranks": [1]}},
+         {"s": 2, "t": 125.0, "kind": "pod", "name": "failover",
+          "data": {"leader": 0, "addr": "127.0.0.1:1"}}])
+    return d
+
+
+def test_cli_verdict_names_first_dead_and_aligns_clocks(tmp_path,
+                                                        capsys):
+    from mxnet_tpu.obs.__main__ import main as obs_main
+    d = _synthetic_pod(tmp_path)
+    assert obs_main(["blackbox", d]) == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("POD-BLACKBOX-VERDICT ")][0]
+    verdict = json.loads(line.split(" ", 1)[1])
+    assert verdict["first_dead"] == 1
+    assert verdict["dead"] == [1] and verdict["survivors"] == [0]
+    # clock alignment: the skewed rank's wall 115 lands at 110 — BEFORE
+    # the survivor's detection at 120
+    assert verdict["last_event"]["t"] == pytest.approx(110.0)
+    assert verdict["last_fault"]["site"] == "host.die"
+    assert verdict["armed_faults"] == ["host.die@12:hostkill"]
+    view = verdict["survivor_views"]["0"]
+    assert [e["name"] for e in view] == ["dead-hosts", "failover"]
+    assert verdict["failovers"][0]["t"] > verdict["last_event"]["t"]
+
+
+def test_cli_merged_timeline_is_valid_chrome_trace(tmp_path):
+    from mxnet_tpu.obs.__main__ import main as obs_main
+    d = _synthetic_pod(tmp_path)
+    # a per-rank chrome trace merges in, shifted onto the aligned clock
+    with open(os.path.join(d, "profile-p0.json"), "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "op", "ph": "X", "ts": 0.0, "dur": 5.0,
+             "pid": 0, "tid": 1}]}, f)
+    # give rank 0's header the trace anchor
+    path = os.path.join(d, "blackbox-p0.jsonl")
+    lines = open(path).read().splitlines()
+    header = json.loads(lines[0])
+    header["trace0_wall"] = 118.0
+    with open(path, "w") as f:
+        f.write("\n".join([json.dumps(header)] + lines[1:]) + "\n")
+    assert obs_main(["blackbox", d]) == 0
+    with open(os.path.join(d, "pod-timeline.json")) as f:
+        merged = json.load(f)
+    events = merged["traceEvents"]
+    assert events and isinstance(events, list)
+    # rank lanes: pid == pod rank, with process_name metadata
+    pids = {e.get("pid") for e in events if e.get("ph") != "M"}
+    assert pids >= {0, 1}
+    names = {e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert names == {"rank 0", "rank 1"}
+    # the shifted chrome-trace op landed under rank 0's pid at
+    # (118 - 100) * 1e6 us on the merged clock (aligned_min = 100)
+    ops = [e for e in events if e.get("name") == "op"]
+    assert ops and ops[0]["pid"] == 0
+    assert ops[0]["ts"] == pytest.approx(18e6)
+    # span events render as complete slices with durations
+    spans = [e for e in events if e.get("name") == "span:step"]
+    assert spans and spans[0]["ph"] == "X" and spans[0]["dur"] > 0
+
+
+def test_cli_all_clean_pod(tmp_path, capsys):
+    from mxnet_tpu.obs.__main__ import main as obs_main
+    _write_rank_file(os.path.join(str(tmp_path), "blackbox-p0.jsonl"),
+                     0, "child", "exit",
+                     [{"s": 1, "t": 10.0, "kind": "epoch",
+                       "name": "end"}])
+    assert obs_main(["blackbox", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    verdict = json.loads([ln for ln in out.splitlines()
+                          if ln.startswith("POD-BLACKBOX-VERDICT ")][0]
+                         .split(" ", 1)[1])
+    assert verdict["first_dead"] is None and verdict["dead"] == []
+
+
+def test_cli_empty_dir_fails_legibly(tmp_path, capsys):
+    from mxnet_tpu.obs.__main__ import main as obs_main
+    assert obs_main(["blackbox", str(tmp_path)]) == 2
+    assert "no blackbox" in capsys.readouterr().out
+
+
+# ------------------------------------------------------ lint wiring
+
+
+def test_lint_rules_hold_over_recorder_and_flush_paths():
+    """The satellite wiring: the signal-unsafe and wall-clock lint
+    rules run over the recorder and every module that flushes it — the
+    recorder's SIGTERM flush is exactly the hazard class the lint
+    exists for. The recorder's single wall-clock anchor and the PodKV
+    clock exchange carry explicit, justified inline allows; nothing
+    may register a signal handler that touches the recorder."""
+    from mxnet_tpu.analysis.lint import lint_paths
+    paths = [os.path.join(REPO, "mxnet_tpu", "obs", "blackbox.py"),
+             os.path.join(REPO, "mxnet_tpu", "obs", "straggler.py"),
+             os.path.join(REPO, "mxnet_tpu", "obs", "__main__.py"),
+             os.path.join(REPO, "mxnet_tpu", "faults.py"),
+             os.path.join(REPO, "mxnet_tpu", "elastic.py"),
+             os.path.join(REPO, "mxnet_tpu", "parallel", "dist.py")]
+    report = lint_paths(paths)
+    bad = [f for f in report.findings
+           if f.code in ("signal-unsafe", "wall-clock")]
+    assert not bad, ["%s:%s %s" % (f.path, f.line, f.message)
+                     for f in bad]

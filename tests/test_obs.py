@@ -279,6 +279,81 @@ def test_render_survives_nonfinite_gauges():
         _profiler.set_gauge("obs_test_nan_gauge", 0.0)
 
 
+def test_labeled_histogram_round_trip_with_le():
+    """PR 11 federation path, parser side: a histogram rendered under
+    pod identity labels must round-trip with BOTH the identity labels
+    and the per-bucket ``le`` on every bucket sample, cumulative counts
+    intact — and two hosts' expositions of the SAME metric must
+    coexist after a federated concatenation."""
+    h = _profiler.histogram("obs_fed_hist")
+    h.reset()
+    for v in (0.001, 0.004, 0.4):
+        h.observe(v)
+    lab0 = {"process_index": "0", "world_size": "2"}
+    lab1 = {"process_index": "1", "world_size": "2"}
+    # a federated scrape body: both hosts' renders concatenated
+    text = mx.obs.render_prometheus(labels=lab0) + \
+        mx.obs.render_prometheus(labels=lab1)
+    samples = mx.obs.parse_prometheus(text)
+
+    def bucket(le, **labels):
+        return samples[("mxnet_tpu_obs_fed_hist_bucket",
+                        tuple(sorted(dict(labels, le=le).items())))]
+
+    for lab in (lab0, lab1):
+        assert bucket("+Inf", **lab) == 3
+        assert samples[("mxnet_tpu_obs_fed_hist_count",
+                        tuple(sorted(lab.items())))] == 3
+        assert samples[("mxnet_tpu_obs_fed_hist_sum",
+                        tuple(sorted(lab.items())))] == \
+            pytest.approx(0.405)
+        # cumulative in le within ONE label set
+        series = sorted(
+            ((float("inf") if lbl_d["le"] == "+Inf"
+              else float(lbl_d["le"])), v)
+            for (n, lbl), v in samples.items()
+            if n == "mxnet_tpu_obs_fed_hist_bucket"
+            for lbl_d in [dict(lbl)]
+            if lbl_d.get("process_index") == lab["process_index"])
+        assert [v for _le, v in series] == \
+            sorted(v for _le, v in series)
+        assert series[-1][1] == 3
+
+
+def test_labeled_nonfinite_gauges_round_trip():
+    import math
+    _profiler.set_gauge("obs_fed_inf", float("inf"))
+    _profiler.set_gauge("obs_fed_nan", float("nan"))
+    try:
+        lab = {"process_index": "3", "world_size": "4"}
+        samples = mx.obs.parse_prometheus(
+            mx.obs.render_prometheus(labels=lab))
+        key = tuple(sorted(lab.items()))
+        assert samples[("mxnet_tpu_obs_fed_inf", key)] == math.inf
+        assert math.isnan(samples[("mxnet_tpu_obs_fed_nan", key)])
+    finally:
+        _profiler.set_gauge("obs_fed_inf", 0.0)
+        _profiler.set_gauge("obs_fed_nan", 0.0)
+
+
+def test_same_name_different_labels_coexist():
+    """Rank 3's sample must never overwrite rank 0's — the exact
+    collision pod_labels() exists to prevent."""
+    _profiler.incr_counter("obs_fed_ctr", 2)
+    text = mx.obs.render_prometheus(
+        labels={"process_index": "0", "world_size": "2"}) + \
+        mx.obs.render_prometheus(
+            labels={"process_index": "1", "world_size": "2"})
+    samples = mx.obs.parse_prometheus(text)
+    keys = [lbl for (n, lbl) in samples
+            if n == "mxnet_tpu_obs_fed_ctr_total"]
+    assert len(keys) == 2 and keys[0] != keys[1]
+    # and the bare (unlabeled) sample is a THIRD distinct series
+    samples_bare = mx.obs.parse_prometheus(
+        mx.obs.render_prometheus(labels={}))
+    assert ("mxnet_tpu_obs_fed_ctr_total", ()) in samples_bare
+
+
 def test_parse_prometheus_rejects_malformed():
     with pytest.raises(ValueError):
         mx.obs.parse_prometheus("not a metric line !!!\n")

@@ -1,0 +1,285 @@
+"""Pod observability (ISSUE 13): straggler detection over federated
+step telemetry, pod-suffixed profiler dumps, and the coordinator's
+opt-in /metrics endpoint.
+
+The 2-process drills use the same localhost DMLC fake-cluster pattern
+as tests/test_dist.py; the aggregation math itself is unit-tested
+against fake windows (fires on a slow rank / stays silent balanced,
+counter-asserted both ways — the ISSUE 13 acceptance pair).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler as _profiler
+from mxnet_tpu.obs import straggler as _straggler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_obs_pod_worker.py")
+
+
+def _free_port():
+    from mxnet_tpu.parallel.dist import free_port
+    return free_port()
+
+
+# ------------------------------------------------- aggregation units
+
+
+def _fake_reader(windows):
+    """reader(key, timeout_ms) over {rank: payload} fake windows."""
+    def read(key, _timeout_ms):
+        rank = int(key.rsplit("/", 1)[1])
+        payload = windows.get(rank)
+        return None if payload is None else json.dumps(payload)
+    return read
+
+
+def _window(rank, count, work_s, wall_s=None):
+    return {"rank": rank, "epoch": 0, "gen": 0, "count": count,
+            "wall_s": wall_s if wall_s is not None else work_s,
+            "work_s": work_s}
+
+
+def test_aggregate_flags_slow_rank():
+    mx.config.set("MXNET_TPU_OBS_STRAGGLER_RATIO", 2.0)
+    try:
+        before = _profiler.get_counter("obs_straggler")
+        block = _straggler.aggregate(2, _fake_reader({
+            0: _window(0, 20, 2.0),      # 10 steps/s of local work
+            1: _window(1, 20, 10.0),     # 2 steps/s — 5x slower
+        }), gen=0)
+        assert block is not None
+        assert block["stragglers"] == [1], block
+        assert block["slow_fast_ratio"] == pytest.approx(5.0), block
+        assert _profiler.get_counter("obs_straggler") == before + 1
+        assert _profiler.get_gauge("obs_pod_straggler_r1") == 1.0
+        assert _profiler.get_gauge("obs_pod_straggler_r0") == 0.0
+        assert _profiler.get_gauge("obs_pod_work_per_sec_r1") == \
+            pytest.approx(2.0)
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_STRAGGLER_RATIO")
+
+
+def test_aggregate_silent_on_balanced_pod():
+    mx.config.set("MXNET_TPU_OBS_STRAGGLER_RATIO", 2.0)
+    try:
+        before = _profiler.get_counter("obs_straggler")
+        block = _straggler.aggregate(2, _fake_reader({
+            0: _window(0, 20, 2.0),
+            1: _window(1, 20, 2.4),      # 1.2x: inside the ratio
+        }), gen=0)
+        assert block["stragglers"] == [], block
+        assert _profiler.get_counter("obs_straggler") == before
+        assert _profiler.get_gauge("obs_pod_straggler_r1") == 0.0
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_STRAGGLER_RATIO")
+
+
+def test_aggregate_reports_under_stable_pod_rank():
+    """After a fail-over the DMLC slots are generation-renumbered:
+    windows carrying pod_rank must be flagged/gauged under the ORIGINAL
+    rank (the identity the flight-recorder files use), never the
+    slot."""
+    mx.config.set("MXNET_TPU_OBS_STRAGGLER_RATIO", 2.0)
+    try:
+        # survivors of a dead rank 0: slots 0,1 are original ranks 1,2
+        block = _straggler.aggregate(2, _fake_reader({
+            0: dict(_window(0, 20, 2.0), pod_rank=1),
+            1: dict(_window(1, 20, 10.0), pod_rank=2),
+        }), gen=1)
+        assert set(block["ranks"]) == {"1", "2"}, block
+        assert block["stragglers"] == [2], block
+        assert _profiler.get_gauge("obs_pod_straggler_r2") == 1.0
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_STRAGGLER_RATIO")
+        # leave no flagged gauge behind for other tests
+        _straggler.aggregate(2, _fake_reader({
+            0: dict(_window(0, 20, 2.0), pod_rank=1),
+            1: dict(_window(1, 20, 2.0), pod_rank=2)}), gen=1)
+
+
+def test_aggregate_zeroes_gauges_of_departed_ranks():
+    """A flagged rank whose windows stop arriving (host death, reshard
+    to a smaller world) must not keep serving straggler=1.0 forever."""
+    mx.config.set("MXNET_TPU_OBS_STRAGGLER_RATIO", 2.0)
+    try:
+        _straggler.aggregate(2, _fake_reader({
+            0: _window(0, 20, 2.0),
+            1: _window(1, 20, 10.0),
+        }), gen=0)
+        assert _profiler.get_gauge("obs_pod_straggler_r1") == 1.0
+        # rank 1 is gone: the next aggregation only sees rank 0
+        _straggler.aggregate(1, _fake_reader({
+            0: _window(0, 20, 2.0),
+        }), gen=0)
+        assert _profiler.get_gauge("obs_pod_straggler_r1") == 0.0
+        assert _profiler.get_gauge("obs_pod_steps_per_sec_r1") == 0.0
+        assert _profiler.get_gauge("obs_pod_work_per_sec_r1") == 0.0
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_STRAGGLER_RATIO")
+
+
+def test_aggregate_handles_missing_and_garbage_windows():
+    mx.config.set("MXNET_TPU_OBS_STRAGGLER_RATIO", 2.0)
+    try:
+        # only one usable window: no ratio, no stragglers, no crash
+        def read(key, _t):
+            rank = int(key.rsplit("/", 1)[1])
+            return json.dumps(_window(0, 10, 1.0)) if rank == 0 \
+                else "not json"
+        block = _straggler.aggregate(2, read, gen=0)
+        assert block["stragglers"] == []
+        assert block["slow_fast_ratio"] is None
+        assert _straggler.aggregate(2, lambda k, t: None, gen=0) is None
+    finally:
+        mx.config.reset("MXNET_TPU_OBS_STRAGGLER_RATIO")
+
+
+# --------------------------------------------------- 2-process drills
+
+
+def _run_pod(mode, tmp_path, timeout=420.0):
+    port = _free_port()
+    outdir = str(tmp_path)
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "",
+           "MXNET_TPU_OBS_STRAGGLER_RATIO": "3",
+           "MXNET_TPU_DIST_TIMEOUT": "60",
+           "DMLC_ROLE": "worker", "DMLC_PS_ROOT_URI": "127.0.0.1",
+           "DMLC_PS_ROOT_PORT": str(port), "DMLC_NUM_WORKER": "2",
+           "DMLC_NUM_SERVER": "0"}
+    for k in ("MXNET_TPU_FAULTS", "MXNET_TPU_OBS_BLACKBOX",
+              "MXNET_TPU_POD_KV"):
+        env.pop(k, None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, mode, outdir],
+        env={**env, "DMLC_WORKER_ID": str(r)},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for r in range(2)]
+    outs = [p.communicate(timeout=timeout) for p in procs]
+    dump = "\n".join("--- rank %d rc=%s\n%s\n%s"
+                     % (i, p.returncode, o[-3000:], e[-3000:])
+                     for i, (p, (o, e)) in enumerate(zip(procs, outs)))
+    assert all(p.returncode == 0 for p in procs), dump
+    results = {}
+    for r in range(2):
+        with open(os.path.join(outdir, "result-r%d.json" % r)) as f:
+            results[r] = json.load(f)
+    return results, dump
+
+
+@pytest.mark.slow
+def test_straggler_fires_on_injected_slow_rank(tmp_path):
+    """ISSUE 13 acceptance: a rank slow-faulted every batch must be
+    flagged by the leader's log-boundary aggregation, and the profiler
+    dump default path must come out rank-suffixed on BOTH ranks."""
+    results, dump = _run_pod("slow", tmp_path)
+    r0 = results[0]
+    assert r0["obs_straggler"] > 0, (r0, dump)
+    assert r0["block"] is not None and r0["block"]["stragglers"] == [1], \
+        (r0, dump)
+    assert r0["gauges"].get("obs_pod_straggler_r1") == 1.0, r0
+    assert r0["gauges"].get("obs_pod_straggler_r0") == 0.0, r0
+    # the pod block rides mx.obs.report()
+    assert r0["report_pod"] is not None and \
+        r0["report_pod"]["stragglers"] == [1], r0
+    # per-rank rates present for both ranks
+    assert set(r0["block"]["ranks"]) == {"0", "1"}, r0
+    # the slow rank itself never aggregates (leader-only)
+    assert results[1]["obs_straggler"] == 0, results[1]
+    # satellite: default profiler dump is rank-suffixed under a pod
+    assert results[0]["dump"] == "profile-p0.json", results[0]
+    assert results[1]["dump"] == "profile-p1.json", results[1]
+    for r in range(2):
+        with open(os.path.join(str(tmp_path),
+                               "profile-p%d.json" % r)) as f:
+            trace = json.load(f)
+        assert isinstance(trace["traceEvents"], list)
+
+
+@pytest.mark.slow
+def test_straggler_silent_on_balanced_pod(tmp_path):
+    """The other half of the acceptance pair: identical per-batch work
+    on both ranks must not fire (counter stays 0, no flagged ranks)."""
+    results, dump = _run_pod("balanced", tmp_path)
+    r0 = results[0]
+    assert r0["obs_straggler"] == 0, (r0, dump)
+    assert r0["block"] is None or r0["block"]["stragglers"] == [], r0
+    assert r0["publish_failed"] == 0, r0
+
+
+def test_single_process_dump_keeps_default_name(tmp_path, monkeypatch):
+    """No pod -> no suffix: the default filename stays profile.json and
+    an explicit set_config() filename is always respected."""
+    monkeypatch.chdir(tmp_path)
+    _profiler.set_config(filename="profile.json")
+    _profiler.set_state("run")
+    (mx.nd.ones((2, 2)) + 1).asnumpy()
+    _profiler.set_state("stop")
+    path = _profiler.dump()
+    assert os.path.basename(path) == "profile.json"
+    assert os.path.exists(path)
+
+
+# ------------------------------------------- coordinator /metrics
+
+
+@pytest.mark.slow
+def test_coordinator_metrics_endpoint_no_backend(tmp_path):
+    """Satellite: the pod coordinator exposes the opt-in /metrics
+    endpoint (elastic_* counters render) WITHOUT initializing any jax
+    backend — proven by running it under an unresolvable JAX_PLATFORMS
+    (any backend init would die loudly, the PR 11 trick)."""
+    from mxnet_tpu.obs.prometheus import parse_prometheus
+    port = _free_port()
+    mport = _free_port()
+    env = {**os.environ, "PYTHONPATH": "",
+           "JAX_PLATFORMS": "no_such_platform",
+           "MXNET_TPU_OBS_METRICS_PORT": str(mport),
+           "MXNET_TPU_DIST_TIMEOUT": "30",
+           "MXNET_TPU_HEARTBEAT_PERIOD": "0.5",
+           "DMLC_ROLE": "worker", "DMLC_PS_ROOT_URI": "127.0.0.1",
+           "DMLC_PS_ROOT_PORT": str(port), "DMLC_NUM_WORKER": "1",
+           "DMLC_NUM_SERVER": "0", "DMLC_WORKER_ID": "0"}
+    for k in ("MXNET_TPU_FAULTS", "MXNET_TPU_OBS_BLACKBOX"):
+        env.pop(k, None)
+    child = ("import time; time.sleep(8)")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.elastic", "--coordinated",
+         "--", sys.executable, "-c", child],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        body = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and body is None:
+            if proc.poll() is not None:
+                break
+            try:
+                with urllib.request.urlopen(
+                        "http://127.0.0.1:%d/metrics" % mport,
+                        timeout=2.0) as resp:
+                    body = resp.read().decode("utf-8")
+            except OSError:
+                time.sleep(0.3)
+        assert body is not None, \
+            "never scraped the coordinator /metrics\n%s" % str(
+                proc.communicate(timeout=30))
+        samples = parse_prometheus(body)       # strict grammar check
+        names = {n for n, _labels in samples}
+        assert "mxnet_tpu_elastic_world" in names, sorted(names)[:40]
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, (proc.returncode, out[-3000:],
+                                      err[-3000:])
+        assert "POD-COORDINATOR-EXIT" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()

@@ -285,12 +285,18 @@ def _zero_cost():
             optimizer_params={"learning_rate": 0.3})
     assert "mxnet_tpu.parallel.dist" not in sys.modules, \
         "the pod stack was imported in a plain single-process fit"
+    assert "mxnet_tpu.obs.blackbox" not in sys.modules, \
+        "the flight recorder was imported with its knob off"
+    assert "mxnet_tpu.obs.straggler" not in sys.modules, \
+        "the straggler stack was imported in a single-process fit"
     from mxnet_tpu.checkpoint import pod_info
     assert pod_info() == (0, 1)
     for name in ("fault_injected", "elastic_restart", "elastic_reshard",
                  "elastic_dead_host", "ckpt_preempt_save_failed",
                  "elastic_leader_failover", "loop_nonfinite",
-                 "dist_kv_retry", "ckpt_pod_finalized"):
+                 "dist_kv_retry", "ckpt_pod_finalized",
+                 "obs_blackbox_flush", "obs_straggler",
+                 "obs_straggler_publish_failed"):
         assert profiler.get_counter(name) == 0, name
     assert getattr(mod, "_nancheck_fn", None) is None, \
         "NANCHECK=off must chain nothing onto the fused step"
@@ -318,6 +324,43 @@ def _dmlc_env(base, rank, n, port):
     return env
 
 
+def _assert_blackbox(name, bbdir, base_env, expect_bb):
+    """Post-mortem acceptance: after the drill, the merge CLI must name
+    the first-dead rank, its last fault site, and produce a merged
+    timeline that loads as valid chrome-trace JSON; fail-over
+    transitions must be present and clock-ordered."""
+    proc = _run([sys.executable, "-m", "mxnet_tpu.obs", "blackbox",
+                 bbdir], base_env, 120.0)
+    m = re.search(r"POD-BLACKBOX-VERDICT (\{.*\})", proc.stdout)
+    assert m, "%s: no verdict in:\n%s" % (name, proc.stdout[-4000:])
+    verdict = json.loads(m.group(1))
+    assert verdict["first_dead"] == expect_bb["first_dead"], \
+        (name, verdict)
+    assert verdict.get("last_event"), (name, verdict)
+    lf = verdict.get("last_fault")
+    assert lf and lf["site"] == expect_bb["fault_site"], (name, verdict)
+    assert any(expect_bb["fault_site"] in spec
+               for spec in verdict.get("armed_faults", [])), \
+        (name, verdict)
+    with open(os.path.join(bbdir, "pod-timeline.json")) as f:
+        timeline = json.load(f)
+    assert isinstance(timeline.get("traceEvents"), list) \
+        and timeline["traceEvents"], (name, "empty merged timeline")
+    if expect_bb.get("failover_ranks"):
+        fos = verdict.get("failovers") or []
+        got = {fo["rank"] for fo in fos}
+        assert got >= set(expect_bb["failover_ranks"]), (name, fos)
+        ts = [fo["t"] for fo in fos]
+        assert ts == sorted(ts), (name, "fail-overs not clock-ordered",
+                                  fos)
+        # clock-ordered ACROSS ranks: every survivor's fail-over comes
+        # after the dead leader's last recorded event
+        assert all(t >= verdict["last_event"]["t"] for t in ts), \
+            (name, verdict["last_event"], fos)
+    print("POD-BLACKBOX-OK %s (first_dead=%s fault=%s)"
+          % (name, verdict["first_dead"], lf["site"]), flush=True)
+
+
 def _counters_line(stdout):
     m = re.search(r"POD-COORDINATOR-EXIT rank=(\d+) rc=(-?\d+) "
                   r"restarts=(\d+) reshards=(\d+) dead_hosts=(\d+) "
@@ -338,10 +381,17 @@ def _variant(name, fault, base_env, work, baseline, expect):
     ckpt = os.path.join(vdir, "ckpts")
     out = os.path.join(vdir, "params.npz")
     marker = os.path.join(vdir, "faults.touched")
+    bbdir = os.path.join(vdir, "blackbox")
     port = _free_port()
     env = dict(base_env)
     env.update({"POD_SMOKE_FAULT": fault,
-                "MXNET_TPU_FAULTS_TOUCH": marker})
+                "MXNET_TPU_FAULTS_TOUCH": marker,
+                # flight recorder on for every variant: the post-mortem
+                # drill (expect["blackbox"]) asserts on the merged
+                # timeline after the hostkill; a short heartbeat bounds
+                # how stale a SIGKILL'd host's window can be
+                "MXNET_TPU_OBS_BLACKBOX": bbdir,
+                "MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS": "0.5"})
     cmd = [sys.executable, "-m", "mxnet_tpu.elastic", "--coordinated",
            "--max-restarts", "4", "--",
            os.path.abspath(__file__), "--child", ckpt, out]
@@ -428,6 +478,8 @@ def _variant(name, fault, base_env, work, baseline, expect):
     assert all(rec["process_index"] == 0
                for rec in man["arrays"].values()), \
         "replicated DP params must all be owned by rank 0"
+    if expect.get("blackbox"):
+        _assert_blackbox(name, bbdir, base_env, expect["blackbox"])
     print("POD-VARIANT-OK %s (rc1=%s restarts=%d reshards=%d "
           "dead_hosts=%d)" % (name, rc1, rec0["restarts"],
                               rec0["reshards"], rec0["dead_hosts"]),
@@ -446,10 +498,13 @@ def _leader_variant(name, faults_spec, world, base_env, work, baseline,
     ckpt = os.path.join(vdir, "ckpts")
     out = os.path.join(vdir, "params.npz")
     marker = os.path.join(vdir, "faults.touched")
+    bbdir = os.path.join(vdir, "blackbox")
     port = _free_port()
     env = dict(base_env)
     env.update({"POD_SMOKE_FAULTS": faults_spec,
-                "MXNET_TPU_FAULTS_TOUCH": marker})
+                "MXNET_TPU_FAULTS_TOUCH": marker,
+                "MXNET_TPU_OBS_BLACKBOX": bbdir,
+                "MXNET_TPU_OBS_BLACKBOX_FLUSH_SECS": "0.5"})
     env.update(expect.get("env", {}))
     # budget headroom: one leader loss can cost TWO restarts on a rank
     # whose child died before its monitor saw the dark control plane
@@ -528,6 +583,8 @@ def _leader_variant(name, faults_spec, world, base_env, work, baseline,
                 with open(mf) as f:
                     worlds.add(json.load(f).get("world_size"))
         assert expect["manifest_world"] in worlds, (worlds, dump)
+    if expect.get("blackbox"):
+        _assert_blackbox(name, bbdir, base_env, expect["blackbox"])
     print("POD-LEADER-VARIANT-OK %s (rcs=%s)"
           % (name, [p.returncode for p in sups]), flush=True)
 
@@ -642,7 +699,9 @@ def main():
     base_env = {**os.environ, "PYTHONPATH": REPO,
                 "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "", **KNOBS}
     for k in ("MXNET_TPU_FAULTS", "MXNET_TPU_CKPT_TEST_CRASH",
-              "MXNET_TPU_FAULTS_TOUCH", "POD_SMOKE_FAULT"):
+              "MXNET_TPU_FAULTS_TOUCH", "POD_SMOKE_FAULT",
+              "MXNET_TPU_OBS_BLACKBOX", "MXNET_TPU_POD_KV",
+              "MXNET_TPU_POD_RANK"):
         base_env.pop(k, None)
 
     # ---- uninterrupted baseline: a 1-host pod over the full data -----
@@ -659,7 +718,8 @@ def main():
     variants = [
         ("hostkill", "host.die@%d:hostkill" % DIE_AT,
          {"rc1": (-signal.SIGKILL,), "reshards_min": 1,
-          "marker": "host.die@%d:hostkill" % DIE_AT}),
+          "marker": "host.die@%d:hostkill" % DIE_AT,
+          "blackbox": {"first_dead": 1, "fault_site": "host.die"}}),
         ("wedge", "host.die@%d:wedge" % DIE_AT,
          {"rc1": (-signal.SIGKILL,), "frozen": True, "reshards_min": 1,
           "dead_hosts_min": 1,
@@ -691,7 +751,9 @@ def main():
                    2: {"failovers": 1, "restarts_min": 1,
                        "reshards_min": 1}},
           "marker": ["leader.die@%d:hostkill" % DIE_AT],
-          "manifest_world": 3}),
+          "manifest_world": 3,
+          "blackbox": {"first_dead": 0, "fault_site": "leader.die",
+                       "failover_ranks": [1, 2]}}),
         ("leader-cascade",
          "g0w0=leader.die@%d:hostkill;g1w0=leader.die@%d:hostkill"
          % (DIE_AT, CASCADE_AT), 3,
