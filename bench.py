@@ -275,18 +275,25 @@ def section_transformer():
 
 
 def _resnet_run(rec, batch, iters, grad_accum=None, remat=None,
-                section="resnet"):
-    """Shared ResNet-50 bf16 driver: bind (phase-guarded), warm up, time
+                section="resnet", virtual_mesh=False, layers=50,
+                image=224, guard_timeout=None):
+    """Shared ResNet bf16 driver: bind (phase-guarded), warm up, time
     the fused step, fill ``rec`` in place (partial values survive a
-    guard exit)."""
+    guard exit). ``virtual_mesh`` = data-parallel over every visible
+    (virtual CPU) device — the no-TPU fallback rig."""
     import numpy as np
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu.models import resnet
 
     on_tpu = bool(mx.num_devices("tpu"))
-    ctx = mx.tpu(0) if on_tpu else mx.cpu(0)
-    guard = PhaseGuard(section, rec)
+    if virtual_mesh and not on_tpu:
+        ndev = len(jax.devices())
+        ctx = [mx.cpu(i) for i in range(ndev)] if ndev > 1 else mx.cpu(0)
+        rec["n_devices"] = ndev
+    else:
+        ctx = mx.tpu(0) if on_tpu else mx.cpu(0)
+    guard = PhaseGuard(section, rec, timeout=guard_timeout)
 
     mx.amp.init("bfloat16")   # bf16 MXU compute, fp32 master weights
     if remat is not None:
@@ -297,10 +304,11 @@ def _resnet_run(rec, batch, iters, grad_accum=None, remat=None,
         # space-to-depth stem: mathematically identical to the 7x7/2
         # stem on the same parameter, ~2 ms/step faster (docs/perf.md
         # round-5 restructuring sweep)
-        sym = resnet.get_symbol(num_classes=1000, num_layers=50,
-                                stem="s2d")
+        sym = resnet.get_symbol(num_classes=1000, num_layers=layers,
+                                stem="s2d",
+                                image_shape="3,%d,%d" % (image, image))
         mod = mx.mod.Module(sym, context=ctx)
-        mod.bind(data_shapes=[("data", (batch, 3, 224, 224))],
+        mod.bind(data_shapes=[("data", (batch, 3, image, image))],
                  label_shapes=[("softmax_label", (batch,))])
         mod.init_params(mx.init.Xavier(rnd_type="gaussian",
                                        factor_type="in", magnitude=2))
@@ -313,10 +321,11 @@ def _resnet_run(rec, batch, iters, grad_accum=None, remat=None,
     _note("bench: %s bound in %.1fs" % (section, rec["bind_secs"]))
 
     rng = np.random.RandomState(0)
-    x = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)
     y = rng.randint(0, 1000, (batch,)).astype(np.float32)
-    dbatch = mx.io.DataBatch(data=[mx.nd.array(x, ctx=ctx)],
-                             label=[mx.nd.array(y, ctx=ctx)])
+    host_ctx = ctx[0] if isinstance(ctx, list) else ctx
+    dbatch = mx.io.DataBatch(data=[mx.nd.array(x, ctx=host_ctx)],
+                             label=[mx.nd.array(y, ctx=host_ctx)])
 
     def drain():
         # On the experimental remote-TPU plugin this machine uses,
@@ -380,13 +389,49 @@ def section_resnet():
 def section_resnet_remat_accum():
     """The ISSUE 9 memory levers applied: 2x the round-5 batch, fit in
     HBM via auto-remat + 2-way gradient accumulation, MFU vs the 0.29
-    plain-batch baseline."""
+    plain-batch baseline.
+
+    No-TPU fallback (ISSUE 14, retiring the BENCH_r05 rc-124 note): the
+    section used to ship EMPTY whenever the TPU tunnel was unreachable —
+    rounds 5-13 never carried a resnet_remat_accum record at all. Now it
+    runs the same levers on the host (8-device virtual CPU mesh, small
+    batch) and records a clearly-labeled fallback line: img/s is a
+    CPU number (never compare against TPU rounds — the `fallback` key
+    marks it), but the remat_applied/accum_steps/loop_recompile counters
+    prove the levers engaged, so the section never again ships empty."""
+    # the fallback needs the virtual mesh; the flag must land before
+    # jax initializes in this section's child process. 2 devices, not 8:
+    # SPMD-partitioning ResNet-50 (+ remat + the accum scan) for 8
+    # virtual CPU devices blows the 300s PhaseGuard compile budget —
+    # 2 still proves mesh + levers compose and compiles in budget
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] in ("", "cpu") \
+            and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=2")
     import mxnet_tpu as mx
     on_tpu = bool(mx.num_devices("tpu"))
-    if not on_tpu:
-        return {"skipped": "no tpu attached"}
-    return _resnet_run({}, 2 * BATCH, ITERS, grad_accum=2, remat="auto",
-                       section="resnet_remat_accum")
+    if on_tpu:
+        return _resnet_run({}, 2 * BATCH, ITERS, grad_accum=2,
+                           remat="auto", section="resnet_remat_accum")
+    rec = {"fallback": "cpu-virtual-mesh",
+           "fallback_model": "resnet18@112",
+           "note": "no tpu attached; levers exercised on the virtual "
+                   "CPU mesh so the record is never empty — a RESNET-18 "
+                   "@112px CPU number, NOT comparable to the TPU "
+                   "resnet50 rounds (XLA-CPU compiles the accum scan of "
+                   "resnet50@224 in ~300s+, past the phase budget; "
+                   "r18@112 x 2 devices compiles in ~80s)"}
+    rec = _resnet_run(rec, 16, 2, grad_accum=2, remat="auto",
+                      section="resnet_remat_accum", virtual_mesh=True,
+                      layers=18, image=112, guard_timeout=450)
+    # a fallback record must never masquerade as the TPU numbers (and
+    # the analytic flops constant is resnet50's, not resnet18's)
+    rec["mfu"] = None
+    rec["vs_baseline"] = None
+    rec["flops_per_img"] = None
+    return rec
 
 
 def run_section(name):
@@ -450,13 +495,22 @@ def main():
     timeout = float(os.environ.get("BENCH_SECTION_TIMEOUT_SECS", "600"))
     records = {}
     for name in SECTIONS:
-        _note("bench: section %s (timeout %ds)" % (name, timeout))
+        # the no-TPU resnet_remat_accum fallback legitimately spends up
+        # to its 450s guard inside ONE compile; give the section head
+        # room so the guard (which leaves a partial record) fires before
+        # the parent timeout (which loses everything)
+        # ... and never below the guard + exit slack, or a lowered
+        # BENCH_SECTION_TIMEOUT_SECS would let the parent kill land
+        # first and lose the partial record the guard exists to save
+        sect_timeout = max(timeout * 1.5, 510) \
+            if name == "resnet_remat_accum" else timeout
+        _note("bench: section %s (timeout %ds)" % (name, sect_timeout))
         rec = {"section": name}
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--section", name],
-                timeout=timeout, stdout=subprocess.PIPE, text=True)
+                timeout=sect_timeout, stdout=subprocess.PIPE, text=True)
             lines = [l for l in (proc.stdout or "").splitlines()
                      if l.strip()]
             parsed = None

@@ -249,22 +249,28 @@ def _audit_tp_mesh(fail_at) -> int:
 
 def _audit_islands(fail_at) -> int:
     """Cross-island spec audit: every parallel mode's canonical layout
-    claims against the default data x model mesh."""
+    claims against the canonical ``data x fsdp x tp`` mesh. Since the
+    SpecLayout unification (ROADMAP item 1) this must report ZERO
+    disagreements — any finding here is an island drifting from the
+    unified layout, and the audit exits 1 on it."""
     import jax
     from . import check_islands
-    from .findings import Severity as S
     from ..parallel import sharding_islands
+    from ..parallel.layout import SpecLayout
     islands = sharding_islands()
     mesh = None
     if len(jax.devices()) >= 8:
-        from ..parallel import make_mesh
-        mesh = make_mesh({"data": 2, "model": 4})
+        mesh = SpecLayout(data=2, fsdp=2, tp=2).mesh()
     report = check_islands(islands, mesh=mesh, context="islands")
-    print("== islands: %d island(s), %d finding(s) (the ROADMAP item-1 "
-          "unification debt, kept visible)" % (len(islands), len(report)))
+    status = "unified (zero disagreements)" if not report.findings else \
+        "%d finding(s) — an island drifted from the unified SpecLayout" \
+        % len(report)
+    print("== islands: %d island(s), %s" % (len(islands), status))
     for f in report:
         print("   " + f.format())
-    return 1 if report.at_least(fail_at) else 0
+    # ANY cross-island finding is a unification regression, not merely
+    # advisory — fail the audit on WARNING-level findings here
+    return 1 if report.findings else 0
 
 
 def _cmd_audit(args) -> int:

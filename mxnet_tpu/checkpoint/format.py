@@ -596,7 +596,10 @@ def reshard_tensors(tensors: Dict[str, np.ndarray], mesh, layout: Layout
     out: Dict[str, Any] = {}
     resharded = 0
     for name, arr in tensors.items():
-        spec = resolve_layout_spec(layout, name)
+        # shape-aware resolution: a SpecLayout's heuristic needs the
+        # array shape (and strips the arg:/aux:/opt: key prefix itself)
+        spec = resolve_layout_spec(layout, name, shape=np.shape(arr),
+                                   dtype=getattr(arr, "dtype", None))
         try:
             validate_spec(mesh, spec, np.shape(arr), name=name)
         except ValueError as exc:

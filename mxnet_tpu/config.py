@@ -250,9 +250,10 @@ register("MXNET_TPU_OBS_METRICS_PORT", int, -1,
          "auto-started by serve.InferenceServer: -1 = off, 0 = ephemeral "
          "port (read it back from server.metrics_port), >0 = fixed port")
 register("MXNET_TPU_OBS_PEAK_FLOPS", float, 0.0,
-         "mx.obs: override the device's peak dense FLOP/s used for the "
-         "obs_mfu gauge (0 = auto-detect by TPU device_kind; set "
-         "explicitly on unknown devices or in tests)")
+         "mx.obs: override the PER-DEVICE peak dense FLOP/s used for "
+         "the obs_mfu gauge — a mesh-bound module's denominator is "
+         "this times the mesh's device count (0 = auto-detect by TPU "
+         "device_kind; set explicitly on unknown devices or in tests)")
 register("MXNET_TPU_OBS_BLACKBOX", str, "",
          "mx.obs flight recorder: directory the bounded in-memory event "
          "ring (span closes, counter deltas, fault fires, pod "
@@ -297,6 +298,12 @@ register("MXNET_TPU_SCAN_LAYERS", _parse_scan_layers, "auto",
          "time stops growing with depth; auto = chains of >= 4 verified-"
          "isomorphic blocks, an integer overrides that minimum, off = "
          "always unroll (the scan module is never imported)")
+register("MXNET_TPU_GROUP_UPDATE", _parse_bool, True,
+         "with a scan plan bound, trace the fused optimizer update as "
+         "ONE vmapped body per per-layer parameter family (stacked "
+         "(L, ...) arrays) instead of L per-param copies — kills the "
+         "remaining O(L) update eqns of deep scanned models; 0 = the "
+         "per-param trace (bisection fallback, bit-identical result)")
 
 
 def _parse_nancheck(v) -> str:

@@ -269,6 +269,35 @@ class Executor:
         # first dispatch (aot.py; single-device programs only)
         self._aot_fwd: Dict[bool, Any] = {}
 
+        # ---- applied remat on the NON-FUSED training path (the other
+        # PR 9 close-out flag): forward_backward + update drivers
+        # (kvstore binds, custom updaters, monitor mode) trace fwd_bwd
+        # below, which never went through Module._build_fused_step's
+        # wrap. With a scan plan the body_wrapper already checkpointed
+        # each repeated block (and that wrap lives inside self._fn, so
+        # fwd_bwd inherits it); only the plan-less whole-forward form is
+        # applied here. Kept on a SEPARATE attribute from _remat_name:
+        # the fused step keys its own wrap off _remat_name, and this
+        # wrap does not reach the fused step's loss_fn.
+        self._fwd_bwd_remat = None
+        if self._wrt and self._remat_name == "off" and \
+                self._scan_plan is None and (
+                    _config.get("MXNET_TPU_REMAT") != "off"
+                    or _config.get("MXNET_EXEC_ENABLE_REMAT")):
+            from . import remat as _remat
+            shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+            shapes.update({n: tuple(a.shape)
+                           for n, a in self.aux_dict.items()})
+            dts = {n: a.dtype for n, a in self.arg_dict.items()}
+            dts.update({n: a.dtype for n, a in self.aux_dict.items()})
+            policy, name = _remat.resolve_policy(
+                self._symbol, input_shapes=shapes, input_dtypes=dts)
+            if policy is not None:
+                self._fwd_bwd_remat = policy
+                self._fwd_bwd_remat_name = name
+                from . import profiler as _profiler
+                _profiler.incr_counter("remat_applied")
+
         def fwd_bwd(arg_vals, aux_vals, key, head_grads):
             diff = {n: arg_vals[n] for n in self._wrt}
             rest = {n: v for n, v in arg_vals.items() if n not in diff}
@@ -277,6 +306,8 @@ class Executor:
                 outs, new_aux = self._fn({**rest, **d}, aux_vals, key, True)
                 return outs, new_aux
 
+            if self._fwd_bwd_remat is not None:
+                f = jax.checkpoint(f, policy=self._fwd_bwd_remat)
             (outs, new_aux), vjp = jax.vjp(f, diff, has_aux=False)
             cts = [g if g is not None else jnp.ones_like(o)
                    for g, o in zip(head_grads, outs)]

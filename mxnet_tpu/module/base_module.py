@@ -261,7 +261,7 @@ class BaseModule(object):
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint=None, resume_from=None,
-            grad_accum=None):
+            grad_accum=None, layout=None):
         """Train the module (reference: base_module.py:376 — the canonical
         forward_backward → update → update_metric loop with epoch/batch
         callbacks and checkpointing hooks).
@@ -303,6 +303,16 @@ class BaseModule(object):
         sees the exact full-batch gradient (BatchNorm statistics advance
         per microbatch). Requires a module with a fused step and
         N | batch size.
+
+        ``layout=`` (docs/architecture/parallelism.md): a
+        ``parallel.SpecLayout`` — THE multi-chip entry point. The bind
+        builds the canonical ``data x fsdp x tp`` mesh, batches shard
+        over ``(data, fsdp)``, parameters AND optimizer states shard per
+        the layout's name heuristic (FSDP/ZeRO + tensor parallel), and
+        GSPMD inserts the collectives. Composes with ``checkpoint=`` /
+        ``resume_from=`` (reshard-on-load resolves through the same
+        layout funnel). Requires a module implementing ``set_layout``
+        (mx.mod.Module).
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
@@ -350,6 +360,22 @@ class BaseModule(object):
                              resume.path, resume.step, begin_epoch,
                              ", batch %d" % resume.batches_done
                              if resume.mid_epoch else "")
+
+        if layout is not None:
+            lay_setter = getattr(self, "set_layout", None)
+            if lay_setter is None:
+                raise MXNetError(
+                    "fit(layout=...): %s does not support the unified "
+                    "SpecLayout (mx.mod.Module does)"
+                    % type(self).__name__)
+            if force_rebind and getattr(self, "binded", False):
+                # the bind below drops the old binding anyway
+                # (force_rebind) — drop it first, or set_layout refuses
+                # to re-lay a live binding and the documented
+                # fit(layout=..., force_rebind=True) path is unreachable
+                self.binded = False
+            # before bind, so the mesh and every placement honor it
+            lay_setter(layout)
 
         if grad_accum is not None:
             setter = getattr(self, "set_grad_accum", None)

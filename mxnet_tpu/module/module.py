@@ -90,7 +90,8 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None, mesh_shape=None, param_shardings=None):
+                 state_names=None, mesh_shape=None, param_shardings=None,
+                 layout=None):
         """``mesh_shape``/``param_shardings`` are the tensor-parallel
         surface (SURVEY §2.21): ``mesh_shape={"data": 2, "model": 4}``
         lays the context list out as a 2D mesh, and ``param_shardings``
@@ -99,7 +100,18 @@ class Module(BaseModule):
         column-shards fc1. The batch stays sharded over ``data``; XLA
         partitions the matmuls and inserts the tensor-parallel collectives
         from the operand shardings (GSPMD), so the same fused train step
-        serves dp, tp, and dp x tp without code changes."""
+        serves dp, tp, and dp x tp without code changes.
+
+        ``layout`` (docs/architecture/parallelism.md) is the unified
+        entry point above both: a ``parallel.SpecLayout`` builds the
+        canonical ``data x fsdp x tp`` mesh, shards every batch over
+        ``(data, fsdp)``, and resolves each parameter's spec through the
+        layout's overrides + name heuristic — parameters AND their
+        optimizer states shard over ``fsdp`` (ZeRO-style), with explicit
+        ``param_shardings`` still winning per name. The same layout
+        object drives checkpoint reshard-on-load
+        (``read_checkpoint(layout=...)``), so save/restore can never
+        resolve differently than the bind."""
         super().__init__(logger=logger)
         if context is None:
             context = cpu()
@@ -109,6 +121,10 @@ class Module(BaseModule):
         self._mesh_shape = dict(mesh_shape) if mesh_shape else None
         self._param_shardings = dict(param_shardings) \
             if param_shardings else None
+        self._layout = None
+        self._batch_sharding = None
+        if layout is not None:
+            self.set_layout(layout)
         # work_load_list existed to weight uneven GPUs
         # (executor_group.py:99); a TPU mesh is homogeneous, accepted and
         # ignored for API compatibility.
@@ -274,15 +290,54 @@ class Module(BaseModule):
         if self._mesh is not None:
             self._replicate_params()
 
+    def set_layout(self, layout) -> None:
+        """Install the unified ``parallel.SpecLayout`` (the ROADMAP
+        item-1 entry point; ``fit(layout=...)`` routes here): the bind
+        builds the canonical ``data x fsdp x tp`` mesh from it, batches
+        shard over ``(data, fsdp)``, and every parameter + optimizer
+        state resolves its spec through the layout (explicit
+        ``param_shardings`` still win per name). Must be called before
+        bind — an already-bound module would need force_rebind to re-lay
+        its buffers out."""
+        if layout is not None and not hasattr(layout, "spec_for"):
+            raise MXNetError(
+                "set_layout expects a parallel.SpecLayout (got %r)"
+                % (type(layout).__name__,))
+        if self.binded:
+            if layout == self._layout:
+                return          # idempotent re-fit with the same layout
+            raise MXNetError(
+                "set_layout must run before bind (rebind with "
+                "force_rebind=True to change an existing module's "
+                "layout)")
+        if layout is not None and self._mesh_shape is not None:
+            raise MXNetError(
+                "layout and mesh_shape are mutually exclusive — the "
+                "layout IS the mesh shape (axes %r)" % (layout.axes(),))
+        self._layout = layout
+
     def _sharding_for(self, name):
         """Resolve a parameter's NamedSharding: an exact or regex match in
-        param_shardings wins (tensor parallel), else replicated (data
-        parallel). Delegates to the canonical resolver shared with
-        checkpoint reshard-on-load (parallel.mesh.resolve_layout_spec)."""
+        param_shardings wins (tensor parallel), then the bound
+        SpecLayout's overrides + name heuristic (FSDP/tp), else
+        replicated (data parallel). Delegates to the canonical resolver
+        shared with checkpoint reshard-on-load
+        (parallel.mesh.resolve_layout_spec)."""
         from jax.sharding import NamedSharding
         from ..parallel.mesh import replicated_sharding, resolve_layout_spec
         if self._param_shardings:
             spec = resolve_layout_spec(self._param_shardings, name)
+            if spec is not None:
+                return NamedSharding(self._mesh, spec)
+        if self._layout is not None:
+            arr = self._exec.arg_dict.get(name) if self._exec is not None \
+                else None
+            if arr is None and self._exec is not None:
+                arr = self._exec.aux_dict.get(name)
+            spec = resolve_layout_spec(
+                self._layout, name,
+                shape=tuple(arr.shape) if arr is not None else None,
+                dtype=arr.dtype if arr is not None else None)
             if spec is not None:
                 return NamedSharding(self._mesh, spec)
         return replicated_sharding(self._mesh)
@@ -332,19 +387,25 @@ class Module(BaseModule):
         shape_hints.update({d.name: d.shape for d in self._label_shapes
                             if d.name in self._symbol.list_arguments()})
 
-        if self._mesh_shape is not None:
+        mesh_shape = self._mesh_shape
+        if mesh_shape is None and self._layout is not None:
+            # the unified layout IS the mesh shape: always all three
+            # canonical axes (size-1 axes cost nothing and keep every
+            # spec valid on every shape)
+            mesh_shape = self._layout.axes()
+        if mesh_shape is not None:
             from ..parallel.mesh import make_mesh
             if len(self._context) > 1:
-                want = int(np.prod([s for s in self._mesh_shape.values()
+                want = int(np.prod([s for s in mesh_shape.values()
                                     if s != -1]))
-                if -1 not in self._mesh_shape.values() \
+                if -1 not in mesh_shape.values() \
                         and want != len(self._context):
                     raise ValueError(
                         "mesh_shape %r uses %d devices but %d contexts "
                         "were given — they must match (use -1 to absorb "
-                        "the rest)" % (self._mesh_shape, want,
+                        "the rest)" % (mesh_shape, want,
                                        len(self._context)))
-            self._mesh = make_mesh(self._mesh_shape,
+            self._mesh = make_mesh(mesh_shape,
                                    contexts=self._context
                                    if len(self._context) > 1 else None)
         elif len(self._context) > 1:
@@ -352,6 +413,27 @@ class Module(BaseModule):
             self._mesh = data_parallel_mesh(self._context)
         else:
             self._mesh = None
+
+        self._batch_sharding = None
+        if self._layout is not None and self._mesh is not None:
+            # one NamedSharding built per bind (the placer is hot), and
+            # the batch divisibility checked HERE so an indivisible
+            # batch fails naming the input, not as an XLA error later
+            from jax.sharding import NamedSharding
+            from ..parallel.mesh import validate_spec
+            spec = self._layout.batch_spec()
+            for d in self._data_shapes + self._label_shapes:
+                if not d.shape:
+                    continue
+                try:
+                    validate_spec(self._mesh, spec, tuple(d.shape),
+                                  name=d.name)
+                except ValueError as exc:
+                    raise MXNetError(
+                        "layout: cannot shard the batch over (%s, %s): %s"
+                        % (self._layout.data_axis, self._layout.fsdp_axis,
+                           exc)) from None
+            self._batch_sharding = NamedSharding(self._mesh, spec)
 
         req = {}
         for n in self._symbol.list_arguments():
@@ -885,20 +967,28 @@ class Module(BaseModule):
         if remat_name == "off" and (
                 _config.get("MXNET_TPU_REMAT") != "off"
                 or _config.get("MXNET_EXEC_ENABLE_REMAT")):
-            from .. import remat as _remat
-            shapes = {n: tuple(a.shape)
-                      for n, a in self._exec.arg_dict.items()}
-            shapes.update({n: tuple(a.shape)
-                           for n, a in self._exec.aux_dict.items()})
-            dts = {n: a.dtype for n, a in self._exec.arg_dict.items()}
-            # aux dtypes too: BatchNorm running stats must price at
-            # their real width in the remat ranking (the PR 8 rule)
-            dts.update({n: a.dtype
-                        for n, a in self._exec.aux_dict.items()})
-            remat_policy, remat_name = _remat.resolve_policy(
-                self._symbol, input_shapes=shapes, input_dtypes=dts)
+            # the executor resolved the same whole-forward policy for
+            # its non-fused fwd_bwd path already — reuse it (one
+            # analysis run per bind, one remat_applied count)
+            remat_policy = getattr(self._exec, "_fwd_bwd_remat", None)
             if remat_policy is not None:
-                _profiler.incr_counter("remat_applied")
+                remat_name = getattr(self._exec, "_fwd_bwd_remat_name",
+                                     "auto")
+            else:
+                from .. import remat as _remat
+                shapes = {n: tuple(a.shape)
+                          for n, a in self._exec.arg_dict.items()}
+                shapes.update({n: tuple(a.shape)
+                               for n, a in self._exec.aux_dict.items()})
+                dts = {n: a.dtype for n, a in self._exec.arg_dict.items()}
+                # aux dtypes too: BatchNorm running stats must price at
+                # their real width in the remat ranking (the PR 8 rule)
+                dts.update({n: a.dtype
+                            for n, a in self._exec.aux_dict.items()})
+                remat_policy, remat_name = _remat.resolve_policy(
+                    self._symbol, input_shapes=shapes, input_dtypes=dts)
+                if remat_policy is not None:
+                    _profiler.incr_counter("remat_applied")
         self._remat_name = remat_name
 
         # ---- microbatch gradient accumulation (fit(grad_accum=N) /
@@ -919,6 +1009,40 @@ class Module(BaseModule):
                         "dimension %d" % (accum, d.name, d.shape[0]))
             accum_scale = _accum_loss_scale(self._symbol, accum)
             _profiler.set_gauge("grad_accum", accum)
+
+        # ---- grouped optimizer update over scan var-lists (the PR 9
+        # close-out lever): with a scan plan bound, the forward already
+        # traces ONE block whatever the depth — but the optimizer update
+        # still traced L per-layer copies of itself (the residual O(L)
+        # program eqns). Each verified per-layer parameter family
+        # (scan_plan.var_lists) updates as ONE vmapped raw_update over
+        # the stacked (L, ...) arrays instead: the update body traces
+        # once per family. Families whose members resolve different
+        # lr/wd multipliers fall back to the per-param path (the vmapped
+        # body resolves mults once, at the template's index).
+        update_groups: List[List[str]] = []
+        grouped_names = set()
+        scan_plan = getattr(self._exec, "_scan_plan", None)
+        if scan_plan is not None and _config.get("MXNET_TPU_GROUP_UPDATE"):
+            pset = set(param_names)
+            for names in scan_plan.var_lists.values():
+                if len(names) < 2 or any(n not in pset for n in names):
+                    continue        # fixed/frozen member: eager per-param
+                mults = {
+                    (optimizer._resolve_mult(optimizer.lr_mult,
+                                             name2idx[n]),
+                     optimizer._resolve_mult(optimizer.wd_mult,
+                                             name2idx[n]))
+                    for n in names}
+                if len(mults) != 1:
+                    continue
+                update_groups.append(list(names))
+                grouped_names.update(names)
+            if update_groups:
+                _profiler.incr_counter("fused_update_grouped")
+                _profiler.set_gauge("fused_update_groups",
+                                    len(update_groups))
+        single_names = [n for n in param_names if n not in grouped_names]
 
         def step(params, states, aux, inputs, frozen_vals, key, lr, t):
             def forward(p_in, aux_in, inp, k):
@@ -966,11 +1090,26 @@ class Module(BaseModule):
             else:
                 outs, new_aux, grads = forward(params, aux, inputs, key)
             new_params, new_states = {}, {}
-            for n in param_names:
+            for n in single_names:
                 w, s = optimizer.raw_update(
                     name2idx[n], params[n], grads[n], states[n], lr=lr, t=t)
                 new_params[n] = w
                 new_states[n] = s
+            for names in update_groups:
+                idx0 = name2idx[names[0]]
+                w_stk = jnp.stack([params[n] for n in names])
+                g_stk = jnp.stack([grads[n] for n in names])
+                s_stk = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *[states[n] for n in names])
+
+                def _one(w, g, s, _i=idx0):
+                    return optimizer.raw_update(_i, w, g, s, lr=lr, t=t)
+
+                nw, ns = jax.vmap(_one)(w_stk, g_stk, s_stk)
+                for i, n in enumerate(names):
+                    new_params[n] = nw[i]
+                    new_states[n] = jax.tree_util.tree_map(
+                        lambda x, _i=i: x[_i], ns)
             return outs, new_params, new_states, new_aux
 
         self._fused_num_update = self._optimizer.num_update
@@ -1271,7 +1410,16 @@ class Module(BaseModule):
         if val.dtype != tgt.data.dtype:
             val = val.astype(tgt.data.dtype)
         if self._mesh is not None:
-            if "data" in self._mesh.axis_names:
+            if val.ndim == 0:
+                # rank-0 inputs have no batch dim to shard (bind-time
+                # validation skips them the same way) — replicate
+                from ..parallel.mesh import replicate
+                val = replicate(self._mesh, val)
+            elif self._batch_sharding is not None:
+                # unified layout: the batch shards over BOTH data-parallel
+                # axes (data, fsdp) — validated at bind
+                val = jax.device_put(val, self._batch_sharding)
+            elif "data" in self._mesh.axis_names:
                 from ..parallel.mesh import shard_batch
                 val = shard_batch(self._mesh, val)
             else:

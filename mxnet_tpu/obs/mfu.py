@@ -36,6 +36,8 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from .. import config as _config
 from .. import profiler as _profiler
 
@@ -150,6 +152,7 @@ def _collect_locked() -> List[Dict[str, Any]]:
     best = None
     for mod in live:
         steps = int(getattr(mod, "_obs_steps", 0))
+        mesh = getattr(mod, "_mesh", None)
         rec: Dict[str, Any] = {
             "name": getattr(mod, "_obs_label", type(mod).__name__),
             "steps": steps,
@@ -159,6 +162,11 @@ def _collect_locked() -> List[Dict[str, Any]]:
             "mfu": None,
             "peak_flops": peak,
             "cost": getattr(mod, "_obs_cost", None),
+            # mesh provenance so multi-chip benches report MFU PER MESH
+            # SHAPE (no parallel import — read the Mesh object directly)
+            "mesh": {str(a): int(s) for a, s in
+                     zip(mesh.axis_names, mesh.devices.shape)}
+            if mesh is not None else None,
         }
         t0 = getattr(mod, "_obs_t0", None)
         # >= so a collect at EXACTLY warmup steps (bench.py's
@@ -186,7 +194,12 @@ def _collect_locked() -> List[Dict[str, Any]]:
             fs = rec["steps_per_sec"] * rec["flops_per_step"]
             rec["flops_per_sec"] = fs
             if peak:
-                rec["mfu"] = fs / peak
+                # a mesh-bound module's denominator is the WHOLE mesh's
+                # peak — flops_per_step is whole-model work, spread over
+                # every device of the mesh
+                n_dev = int(np.prod(list(rec["mesh"].values()))) \
+                    if rec["mesh"] else 1
+                rec["mfu"] = fs / (peak * max(1, n_dev))
             if best is None or fs > best["flops_per_sec"]:
                 best = rec
         out.append(rec)

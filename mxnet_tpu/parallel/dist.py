@@ -26,9 +26,20 @@ __all__ = ["initialize", "is_initialized", "cluster_env", "rank",
            "num_workers", "allreduce_sum", "broadcast", "barrier",
            "heartbeat_start", "heartbeat_stop", "num_dead_nodes",
            "dead_ranks", "reset_liveness", "kv_set", "kv_get",
-           "free_port", "BootstrapTimeout",
+           "free_port", "BootstrapTimeout", "sharding_island",
            "PodKVServer", "PodKVClient", "ProbeRing", "probe_peer",
            "elect_leader", "set_kv_backend", "kv_backend_active"]
+
+
+def sharding_island():
+    """Canonical layout claims of the multi-host data plane (audited by
+    ``analysis.sharding_passes.check_islands``): the cross-host gradient
+    reduction runs over the SAME ``(data, fsdp)`` axes the batch shards
+    over, and parameter residency follows the unified FSDP claim — drawn
+    from the one SpecLayout so the audit reports zero cross-island
+    disagreements."""
+    from .layout import island_specs
+    return "dist", island_specs("dist")
 
 
 def free_port() -> int:

@@ -27,19 +27,35 @@ __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "axis_sizes", "validate_spec", "resolve_layout_spec"]
 
 # a layout maps array name -> PartitionSpec: a dict (exact name match
-# wins, then regex fullmatch), a callable name -> spec, or None
+# wins, then regex fullmatch), a callable name -> spec, a SpecLayout
+# (layout.py — overrides + name heuristic, shape-aware), or None
 # (everything fully replicated)
 Layout = Union[None, Dict[str, Any], Callable[[str], Any]]
 
 
-def resolve_layout_spec(layout: Layout, name: str):
+def resolve_layout_spec(layout: Layout, name: str, shape=None, dtype=None):
     """Resolve one array's partition spec from a layout — THE canonical
     name->spec resolution, shared by ``Module(param_shardings=...)``
     bind-time placement and checkpoint reshard-on-load (two copies of
     this precedence once drifted in the PR 8 spec-conflict audit; keep
-    it single-sourced). ``None`` = replicated."""
+    it single-sourced). ``None`` = replicated.
+
+    A :class:`~mxnet_tpu.parallel.layout.SpecLayout` resolves through
+    its own ``spec_for`` (overrides first, then the name heuristic) with
+    the array's ``shape`` so divisibility-unsafe specs are never
+    emitted; checkpoint keys (``arg:``/``aux:``/``opt:`` prefixes) are
+    stripped to the parameter name so optimizer-state leaves follow
+    their parameter's spec."""
     if layout is None:
         return None
+    if hasattr(layout, "spec_for"):               # SpecLayout (duck-typed)
+        lookup = name
+        if ":" in name:
+            from .layout import strip_ckpt_key
+            lookup = strip_ckpt_key(name)
+            if lookup is None:                    # rng:/upd: bookkeeping
+                return None
+        return layout.spec_for(lookup, shape=shape, dtype=dtype)
     if callable(layout):
         return layout(name)
     spec = layout.get(name)
@@ -52,10 +68,11 @@ def resolve_layout_spec(layout: Layout, name: str):
 
 def sharding_island():
     """This module's canonical layout claims, auditable by
-    ``analysis.sharding_passes.check_islands`` (ROADMAP item 1: today
-    each parallel mode is its own island; the audit makes the
-    disagreements visible until one SpecLayout unifies them)."""
-    return "mesh", {"batch": P("data"), "param": P()}
+    ``analysis.sharding_passes.check_islands`` — drawn from the unified
+    SpecLayout (layout.py) like every other island, so the audit reports
+    zero cross-island disagreements (ROADMAP item 1, done)."""
+    from .layout import island_specs
+    return "mesh", island_specs("mesh")
 
 
 def mesh_devices(contexts: Optional[Sequence[Context]] = None) -> List[jax.Device]:

@@ -11,22 +11,20 @@ comms; everything stays differentiable and jit-compatible.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 __all__ = ["moe_init", "moe_apply", "sharding_island"]
 
 
 def sharding_island():
     """Canonical layout claims of the expert-parallel island (audited by
-    ``analysis.sharding_passes.check_islands``): dispatched activations
-    and expert FFN weights are sharded over the ``expert`` axis — an
-    axis the default ``data x model`` mesh does not carry, which is
-    exactly the cross-island gap the audit surfaces."""
-    from jax.sharding import PartitionSpec as P
-    return "moe", {
-        "expert_in": P("expert", None, None),
-        "expert_out": P("expert", None, None),
-        "expert_param": P("expert", None, None),
-        "batch": P(None),          # tokens arrive unsharded, all_to_all'd
-    }
+    ``analysis.sharding_passes.check_islands``): drawn from the unified
+    SpecLayout — tokens arrive batch-sharded over ``(data, fsdp)`` like
+    everywhere else, and the expert dimension rides the canonical ``tp``
+    model axis (the all_to_all dispatch axis), so the audit reports zero
+    cross-island disagreements."""
+    from .layout import island_specs
+    return "moe", island_specs("moe")
 
 
 def moe_init(rng, d_model: int, d_hidden: int, n_experts: int, dtype=None):
@@ -48,7 +46,7 @@ def moe_init(rng, d_model: int, d_hidden: int, n_experts: int, dtype=None):
 
 
 def moe_apply(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
-              mesh=None, axis: str = "expert"):
+              mesh=None, axis: Optional[str] = None):
     """Apply the MoE FFN to tokens ``x`` of shape (tokens, d_model).
 
     Routing is top-``top_k`` softmax gating with per-expert capacity
@@ -59,6 +57,8 @@ def moe_apply(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
     Under ``jit`` with ``mesh``, the expert dimension of the dispatched
     activations is sharded over ``axis`` so each device runs only its
     experts; the surrounding einsums become all_to_all + local matmul.
+    ``axis=None`` resolves to the legacy ``expert`` axis when the mesh
+    carries it, else the unified SpecLayout's model axis (``tp``).
     Returns (tokens, d_model) combined outputs plus the load-balancing
     auxiliary loss (GShard aux: E * sum_e f_e * p_e).
     """
@@ -66,6 +66,13 @@ def moe_apply(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    if mesh is not None:
+        if axis is None:
+            from .layout import resolve_model_axis
+            axis = resolve_model_axis(mesh, "expert")
+        elif axis not in mesh.axis_names:
+            raise ValueError("mesh has no axis %r (axes: %s)"
+                             % (axis, tuple(mesh.axis_names)))
     T, D = x.shape
     E = params["router"].shape[1]
     k = min(top_k, E)

@@ -31,14 +31,27 @@ __all__ = ["pipeline_apply", "pipeline_1f1b", "stack_stage_params",
 
 def sharding_island():
     """Canonical layout claims of the pipeline island (audited by
-    ``analysis.sharding_passes.check_islands``): stacked stage
-    parameters are sharded over the ``pipe`` axis, microbatch
-    activations ride replicated and hop stages via ``ppermute``."""
-    from jax.sharding import PartitionSpec as P
-    return "pipeline", {
-        "stage_params": P("pipe"),
-        "batch": P(None),
-    }
+    ``analysis.sharding_passes.check_islands``): drawn from the unified
+    SpecLayout — the stacked stage-parameter axis rides the canonical
+    ``tp`` model axis and the batch layout matches every other island,
+    so the audit reports zero cross-island disagreements."""
+    from .layout import island_specs
+    return "pipeline", island_specs("pipeline")
+
+
+def _resolve_axis(mesh, axis):
+    """``axis=None`` resolves to the legacy ``pipe`` axis when the mesh
+    carries it, else the unified SpecLayout's model axis (``tp``) —
+    meshes built with a ``pipe`` axis keep working. An explicit axis is
+    honored verbatim and must exist on the mesh (typos fail loudly
+    instead of silently redirecting to another axis)."""
+    if axis is not None:
+        if axis not in mesh.axis_names:
+            raise ValueError("mesh has no axis %r (axes: %s)"
+                             % (axis, tuple(mesh.axis_names)))
+        return axis
+    from .layout import resolve_model_axis
+    return resolve_model_axis(mesh, "pipe")
 
 
 def stack_stage_params(per_stage_params):
@@ -56,7 +69,7 @@ def stack_stage_params(per_stage_params):
         lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
 
-def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
+def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis=None,
                    first_fn=None, first_params=None,
                    last_fn=None, last_params=None, remat=False):
     """Run ``N = mesh.shape[axis]`` pipeline stages over microbatched input.
@@ -100,6 +113,7 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
+    axis = _resolve_axis(mesh, axis)
     n_stages = mesh.shape[axis]
     leaves = jax.tree_util.tree_leaves(inputs)
     n_micro = leaves[0].shape[0]
@@ -217,7 +231,7 @@ def pipeline_apply(stage_fn, stage_params, inputs, *, mesh, axis="pipe",
     return fn(stage_params, first_params, last_params, inputs)
 
 
-def pipeline_1f1b(stage_fns, stage_params, inputs, *, mesh, axis="pipe",
+def pipeline_1f1b(stage_fns, stage_params, inputs, *, mesh, axis=None,
                   first_fn, first_params, last_fn, last_params, key=None,
                   stage_aux=None):
     """One-forward-one-backward pipeline schedule with a hand-written
@@ -267,6 +281,7 @@ def pipeline_1f1b(stage_fns, stage_params, inputs, *, mesh, axis="pipe",
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
+    axis = _resolve_axis(mesh, axis)
     N = mesh.shape[axis]
     # a single callable = homogeneous stacked mode: params/aux leaves
     # carry a leading N axis SHARDED over the pipe axis (same layout as

@@ -30,13 +30,12 @@ __all__ = ["ring_attention", "ring_self_attention",
 
 def sharding_island():
     """Canonical layout claims of the sequence-parallel island (audited
-    by ``analysis.sharding_passes.check_islands``): q/k/v carry the
-    sequence dim sharded over ``sp`` — another axis the default mesh
-    does not yet carry (ROADMAP item 1)."""
-    return "ring_attention", {
-        "qkv_seq": P(None, None, "sp", None),
-        "batch": P(None),
-    }
+    by ``analysis.sharding_passes.check_islands``): drawn from the
+    unified SpecLayout — the sequence dim rides the canonical ``tp``
+    model axis and the batch layout matches every other island, so the
+    audit reports zero cross-island disagreements."""
+    from .layout import island_specs
+    return "ring_attention", island_specs("ring_attention")
 
 
 def local_attention_block(q, k, v, mask=None, scale=None):
@@ -111,13 +110,21 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+def ring_attention(q, k, v, mesh: Mesh, axis_name: Optional[str] = None,
                    causal: bool = False, scale: Optional[float] = None):
     """Exact attention with the sequence axis sharded over ``axis_name``.
 
     q, k, v: (B, H, S, D) arrays (global view); S is sharded over the mesh
-    axis. Returns (B, H, S, D) with the same sharding.
+    axis. Returns (B, H, S, D) with the same sharding. ``axis_name=None``
+    resolves to the legacy ``sp`` axis when the mesh carries it, else
+    the unified SpecLayout's model axis (``tp``).
     """
+    if axis_name is None:
+        from .layout import resolve_model_axis
+        axis_name = resolve_model_axis(mesh, "sp")
+    elif axis_name not in mesh.axis_names:
+        raise ValueError("mesh has no axis %r (axes: %s)"
+                         % (axis_name, tuple(mesh.axis_names)))
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ring_attention_shard, axis_name=axis_name,
@@ -127,7 +134,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
 
 
 def ring_self_attention(x, w_qkv, w_out, mesh: Mesh, num_heads: int,
-                        axis_name: str = "sp", causal: bool = False):
+                        axis_name: Optional[str] = None,
+                        causal: bool = False):
     """Full self-attention layer with sequence-parallel ring attention:
     x (B, S, E) sharded on S; projections are local (no collective), only
     the kv ring moves data."""

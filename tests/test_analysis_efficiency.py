@@ -408,11 +408,13 @@ def test_fsdp_opportunity_fires_vs_sharded():
 def test_islands_cross_check_runs():
     from mxnet_tpu.parallel import sharding_islands
     islands = sharding_islands()
-    assert {"mesh", "moe", "pipeline", "ring_attention"} <= set(islands)
-    # without a mesh, only cross-island disagreements are reported —
-    # today's islands disagree on the batch layout (ROADMAP item 1)
+    assert {"mesh", "dist", "moe", "pipeline", "ring_attention"} \
+        <= set(islands)
+    # since the SpecLayout unification (ISSUE 14) every island draws
+    # from ONE canonical layout: zero disagreements, with or without a
+    # mesh (tests/test_layout.py pins the with-mesh form too)
     r = check_islands(islands)
-    assert codes(r, "reshard-thrash")
+    assert not codes(r, "reshard-thrash")
     assert not r.errors
 
 

@@ -1,0 +1,263 @@
+"""Grouped fused optimizer update over scan var-lists + remat on the
+non-fused forward_backward path (the two PR 9 close-out levers, landed
+in ISSUE 14).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+
+import jax
+import jax.numpy as jnp
+
+
+L, D, H, T, V, B = 4, 16, 2, 8, 32, 4
+
+
+def _sym(layers=L):
+    return transformer.get_symbol(vocab_size=V, num_layers=layers,
+                                  d_model=D, n_heads=H, seq_len=T)
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, V, (B, T)).astype(np.float32)
+    y = rng.randint(0, V, (B, T)).astype(np.float32)
+    return mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+
+
+def _seed_params(sym):
+    np.random.seed(42)
+    m = mx.mod.Module(sym, context=mx.cpu(0))
+    m.bind(data_shapes=[("data", (B, T))],
+           label_shapes=[("softmax_label", (B, T))])
+    m.init_params(mx.init.Xavier())
+    return {n: mx.nd.array(np.asarray(a.data))
+            for n, a in m._exec.arg_dict.items()}
+
+
+def _train(sym, arg0, group, optimizer="adam", steps=3, lr_mult=None,
+           scan="auto"):
+    mx.config.set("MXNET_TPU_GROUP_UPDATE", group)
+    mx.config.set("MXNET_TPU_SCAN_LAYERS", scan)
+    try:
+        mx.random.seed(11)
+        mod = mx.mod.Module(sym, context=mx.cpu(0))
+        mod.bind(data_shapes=[("data", (B, T))],
+                 label_shapes=[("softmax_label", (B, T))])
+        mod.init_params(arg_params=arg0, aux_params={})
+        opt = mx.optimizer.create(
+            optimizer, learning_rate=0.01, rescale_grad=1.0,
+            param_idx2name={i: n for i, n in
+                            enumerate(mod._param_names)})
+        if lr_mult:
+            opt.set_lr_mult(lr_mult)
+        mod.init_optimizer(optimizer=opt)
+        db = _batch()
+        for _ in range(steps):
+            mod._fit_step(db)
+        jax.block_until_ready(mod._exec.arg_dict["lm_head_weight"].data)
+        return mod
+    finally:
+        mx.config.reset("MXNET_TPU_GROUP_UPDATE")
+        mx.config.reset("MXNET_TPU_SCAN_LAYERS")
+
+
+def _weights(mod):
+    return {n: np.asarray(a.data) for n, a in mod._exec.arg_dict.items()}
+
+
+def test_grouped_update_bit_identical():
+    """The vmapped per-family update is the SAME math elementwise —
+    grouped and per-param runs end bit-identical."""
+    sym = _sym()
+    arg0 = _seed_params(sym)
+    w_on = _weights(_train(sym, arg0, True))
+    w_off = _weights(_train(sym, arg0, False))
+    assert set(w_on) == set(w_off)
+    for k in w_on:
+        np.testing.assert_array_equal(w_on[k], w_off[k], err_msg=k)
+
+
+def test_grouped_update_applies_and_counts():
+    sym = _sym()
+    arg0 = _seed_params(sym)
+    with mx.profiler.counter_delta() as d:
+        mod = _train(sym, arg0, True, steps=1)
+    assert d.all().get("fused_update_grouped", 0) >= 1
+    assert mx.profiler.gauges().get("fused_update_groups", 0) >= 1
+    # the scan plan's families were actually consumed
+    assert mod._exec._scan_plan is not None
+
+
+def test_grouped_update_off_without_scan_plan():
+    """No scan plan (scan off) -> no grouping, knob irrelevant."""
+    sym = _sym()
+    arg0 = _seed_params(sym)
+    with mx.profiler.counter_delta() as d:
+        _train(sym, arg0, True, steps=1, scan="off")
+    assert d.all().get("fused_update_grouped", 0) == 0
+
+
+def test_grouped_update_knob_off_counts_nothing():
+    sym = _sym()
+    arg0 = _seed_params(sym)
+    with mx.profiler.counter_delta() as d:
+        _train(sym, arg0, False, steps=1)
+    assert d.all().get("fused_update_grouped", 0) == 0
+
+
+def test_nonuniform_lr_mult_family_falls_back():
+    """A family whose members resolve different lr multipliers cannot
+    share one vmapped body — it must fall back per-param (and stay
+    correct)."""
+    sym = _sym()
+    arg0 = _seed_params(sym)
+    mult = {"layer1_att_qkv_weight": 0.5}
+    mod = _train(sym, arg0, True, lr_mult=mult, steps=2)
+    w_grp = _weights(mod)
+    w_ref = _weights(_train(sym, arg0, False, lr_mult=mult, steps=2))
+    for k in w_grp:
+        np.testing.assert_array_equal(w_grp[k], w_ref[k], err_msg=k)
+    # the qkv family must NOT have been grouped (one member differs);
+    # other families still group
+    assert mx.profiler.gauges().get("fused_update_groups", 0) >= 1
+
+
+def test_grouped_update_shrinks_the_program():
+    """The deterministic form of the O(L) claim: the fused step's jaxpr
+    carries materially fewer equations with grouping on (the per-layer
+    update chains collapse to one vmapped body per family)."""
+    sym = _sym(layers=6)
+    arg0 = _seed_params(sym)
+
+    def eqns(group):
+        mod = _train(sym, arg0, group, steps=1)
+        params = {n: mod._exec.arg_dict[n].data
+                  for n in mod._param_names}
+        aux = {n: a.data for n, a in mod._exec.aux_dict.items()}
+        inputs = {n: mod._exec.arg_dict[n].data
+                  for n in ("data", "softmax_label")}
+        jaxpr = jax.make_jaxpr(mod._fused_jit.__wrapped__)(
+            params, mod._fused_states, aux, inputs, {},
+            jax.random.PRNGKey(0), jnp.float32(0.01), jnp.int32(1))
+        return len(jaxpr.jaxpr.eqns)
+
+    n_on, n_off = eqns(True), eqns(False)
+    assert n_on < n_off, (n_on, n_off)
+
+
+def test_grouped_update_with_momentum_states():
+    """Stacked state trees (sgd momentum) thread through the vmapped
+    body and come back per-param."""
+    sym = _sym()
+    arg0 = _seed_params(sym)
+    m_on = _train(sym, arg0, True, optimizer="sgd", steps=2)
+    m_off = _train(sym, arg0, False, optimizer="sgd", steps=2)
+    w_on, w_off = _weights(m_on), _weights(m_off)
+    for k in w_on:
+        np.testing.assert_array_equal(w_on[k], w_off[k], err_msg=k)
+    for n, s in m_on._fused_states.items():
+        ref = m_off._fused_states[n]
+        for a, b in zip(jax.tree_util.tree_leaves(s),
+                        jax.tree_util.tree_leaves(ref)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=n)
+
+
+# ------------------------------- remat on the non-fused fwd_bwd path
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _fwd_bwd_grads(remat):
+    mx.config.set("MXNET_TPU_REMAT", remat)
+    try:
+        np.random.seed(5)
+        seed = mx.mod.Module(_mlp(), context=mx.cpu())
+        seed.bind(data_shapes=[("data", (8, 32))],
+                  label_shapes=[("softmax_label", (8,))])
+        seed.init_params(mx.init.Uniform(0.07))
+        arg0 = {n: mx.nd.array(np.asarray(a.data))
+                for n, a in seed._exec.arg_dict.items()}
+
+        rng = np.random.RandomState(0)
+        x = rng.uniform(-1, 1, (8, 32)).astype(np.float32)
+        y = rng.randint(0, 10, (8,)).astype(np.float32)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (8, 32))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(arg_params=arg0, aux_params={})
+        db = mx.io.DataBatch(data=[mx.nd.array(x)],
+                             label=[mx.nd.array(y)])
+        mod.forward_backward(db)
+        applied = mod._exec._fwd_bwd_remat is not None
+        return ({n: np.asarray(g.data)
+                 for n, g in mod._exec.grad_dict.items()}, applied)
+    finally:
+        mx.config.reset("MXNET_TPU_REMAT")
+
+
+def test_fwd_bwd_remat_parity():
+    g_off, a_off = _fwd_bwd_grads("off")
+    g_on, a_on = _fwd_bwd_grads("dots_with_no_batch_dims_saveable")
+    assert not a_off and a_on
+    for k in g_off:
+        np.testing.assert_array_equal(g_on[k], g_off[k], err_msg=k)
+    assert mx.profiler.counters().get("remat_applied", 0) >= 1
+
+
+def test_fwd_bwd_remat_zero_cost_when_off():
+    """MXNET_TPU_REMAT=off builds nothing on the fwd_bwd path."""
+    _g, applied = _fwd_bwd_grads("off")
+    assert not applied
+
+
+def test_fwd_bwd_remat_parity_vs_fused_step():
+    """The rematted non-fused path trains the same step the fused path
+    does (one sgd step, same seed params)."""
+    mx.config.set("MXNET_TPU_REMAT", "dots_with_no_batch_dims_saveable")
+    try:
+        np.random.seed(6)
+        seed = mx.mod.Module(_mlp(), context=mx.cpu())
+        seed.bind(data_shapes=[("data", (8, 32))],
+                  label_shapes=[("softmax_label", (8,))])
+        seed.init_params(mx.init.Uniform(0.07))
+        arg0 = {n: mx.nd.array(np.asarray(a.data))
+                for n, a in seed._exec.arg_dict.items()}
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-1, 1, (8, 32)).astype(np.float32)
+        y = rng.randint(0, 10, (8,)).astype(np.float32)
+        db = mx.io.DataBatch(data=[mx.nd.array(x)],
+                             label=[mx.nd.array(y)])
+
+        def one_step(fused):
+            mod = mx.mod.Module(_mlp(), context=mx.cpu())
+            mod.bind(data_shapes=[("data", (8, 32))],
+                     label_shapes=[("softmax_label", (8,))])
+            mod.init_params(arg_params=arg0, aux_params={})
+            mod.init_optimizer(optimizer="sgd", optimizer_params={
+                "learning_rate": 0.1, "rescale_grad": 1.0 / 8})
+            if fused:
+                mod._fit_step(db)
+            else:
+                mod.forward_backward(db)
+                mod.update()
+            return {n: np.asarray(a.data)
+                    for n, a in mod._exec.arg_dict.items()}
+
+        w_fused = one_step(True)
+        w_eager = one_step(False)
+        for k in w_fused:
+            np.testing.assert_allclose(w_fused[k], w_eager[k],
+                                       rtol=1e-6, atol=1e-7,
+                                       err_msg=k)
+    finally:
+        mx.config.reset("MXNET_TPU_REMAT")

@@ -1,0 +1,345 @@
+"""SpecLayout — the unified ``data x fsdp x tp`` layout (ISSUE 14).
+
+Covers: the dataclass + name-heuristic resolver, the island
+unification pin (check_islands must report ZERO disagreements on the
+canonical mesh — the standing expert/pipe/sp-axis and batch-layout
+findings are gone), Module FSDP end-to-end (params AND optimizer
+states sharded, resident bytes shrink, zero steady-state recompiles),
+fit(layout=), elastic-style bit-identical parity across layouts, and
+checkpoint reshard-on-load through the same layout funnel.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import SpecLayout, parameter_spec_from_name
+from mxnet_tpu.parallel.layout import (island_specs, resolve_model_axis,
+                                       strip_ckpt_key)
+from mxnet_tpu.parallel.mesh import resolve_layout_spec
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+# --------------------------------------------------------- the dataclass
+
+
+def test_speclayout_validation():
+    with pytest.raises(ValueError):
+        SpecLayout(fsdp=0)
+    with pytest.raises(ValueError):
+        SpecLayout(fsdp=-1)
+    with pytest.raises(ValueError):
+        SpecLayout(data=0)
+    with pytest.raises(ValueError):
+        SpecLayout(data=-2)
+
+
+def test_speclayout_axes_sized_world():
+    lo = SpecLayout(data=2, fsdp=2, tp=2)
+    assert lo.axes() == {"data": 2, "fsdp": 2, "tp": 2}
+    assert lo.world_size() == 8
+    ab = SpecLayout(fsdp=2)
+    assert ab.world_size() is None
+    assert ab.sized(8).data == 4
+    with pytest.raises(ValueError):
+        SpecLayout(fsdp=3).sized(8)
+
+
+def test_speclayout_mesh_carries_all_axes():
+    mesh = SpecLayout(data=4, fsdp=2).mesh()
+    assert tuple(mesh.axis_names) == ("data", "fsdp", "tp")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
+        {"data": 4, "fsdp": 2, "tp": 1}
+
+
+# ----------------------------------------------------- the name heuristic
+
+
+def test_param_spec_fsdp_largest_divisible_dim():
+    lo = SpecLayout(data=2, fsdp=4, min_shard_bytes=0)
+    # dim 1 is largest and divisible -> fsdp there
+    assert lo.spec_for("lut_weight", (4, 64)) == P(None, "fsdp")
+    # dim 0 largest
+    assert lo.spec_for("fc1_weight", (2048, 1024)) == P("fsdp")
+    # nothing divisible -> replicated, NEVER an invalid spec
+    assert lo.spec_for("odd_weight", (7, 9)) == P()
+
+
+def test_param_spec_min_shard_bytes_keeps_small_replicated():
+    lo = SpecLayout(data=2, fsdp=4)          # default 1 MiB threshold
+    assert lo.spec_for("small_weight", (64, 64)) == P()
+    assert lo.spec_for("big_weight", (1024, 1024)) != P()
+
+
+def test_param_spec_tp_rules_col_and_row():
+    lo = SpecLayout(data=2, fsdp=2, tp=2, min_shard_bytes=0)
+    # col-parallel names: tp on dim 0 (mxnet FC weight is (out, in))
+    assert lo.spec_for("layer0_att_qkv_weight", (96, 32)) == \
+        P("tp", "fsdp")
+    assert lo.spec_for("fc1_weight", (128, 64)) == P("tp", "fsdp")
+    # row-parallel names: tp on dim 1 (fsdp takes the free dim 0)
+    assert lo.spec_for("fc2_weight", (64, 128)) == P("fsdp", "tp")
+    assert lo.spec_for("layer0_att_out_proj_weight", (32, 32)) == \
+        P("fsdp", "tp")
+
+
+def test_param_spec_unknown_shape_replicates():
+    lo = SpecLayout(data=2, fsdp=4)
+    assert lo.spec_for("anything_weight") == P()
+    assert parameter_spec_from_name("x_weight", None, layout=lo) == P()
+
+
+def test_overrides_win_exact_and_regex():
+    lo = SpecLayout(data=2, fsdp=4, min_shard_bytes=0,
+                    overrides={"special_weight": P("tp"),
+                               r".*_gamma": P("fsdp")})
+    assert lo.spec_for("special_weight", (64, 64)) == P("tp")
+    assert lo.spec_for("bn1_gamma", (64,)) == P("fsdp")
+    # non-matching falls through to the heuristic
+    assert lo.spec_for("fc9_weight", (64, 64)) == P(None, "fsdp") or \
+        lo.spec_for("fc9_weight", (64, 64)) == P("fsdp")
+
+
+def test_resolve_layout_spec_strips_ckpt_keys():
+    lo = SpecLayout(data=2, fsdp=4, min_shard_bytes=0)
+    want = lo.spec_for("fc1_weight", (256, 64))
+    assert resolve_layout_spec(lo, "arg:fc1_weight", (256, 64)) == want
+    assert resolve_layout_spec(lo, "opt:fc1_weight.0", (256, 64)) == want
+    # rng/upd bookkeeping stays replicated
+    assert resolve_layout_spec(lo, "rng:global_key", (4,)) is None
+    assert strip_ckpt_key("rng:global_key") is None
+    assert strip_ckpt_key("opt:fc1_weight.0.1") == "fc1_weight"
+
+
+def test_callable_protocol_shape_blind():
+    lo = SpecLayout(data=2, fsdp=4, overrides={"x_weight": P("fsdp")})
+    assert lo("x_weight") == P("fsdp")       # override, no shape needed
+    assert lo("y_weight") == P()             # heuristic without shape
+
+
+# ------------------------------------------------- the island unification
+
+
+def test_islands_unified_zero_disagreements():
+    """THE ISSUE 14 pin: the standing expert/pipe/sp-axis and
+    batch-layout findings are GONE — every island draws from one
+    SpecLayout, audited against the canonical mesh."""
+    from mxnet_tpu.analysis import check_islands
+    from mxnet_tpu.parallel import sharding_islands
+    islands = sharding_islands()
+    assert set(islands) == {"mesh", "dist", "moe", "pipeline",
+                            "ring_attention"}
+    report = check_islands(islands,
+                           mesh=SpecLayout(data=2, fsdp=2, tp=2).mesh())
+    assert len(report.findings) == 0, \
+        [f.format() for f in report.findings]
+
+
+def test_islands_share_one_batch_layout():
+    from mxnet_tpu.parallel import sharding_islands
+    islands = sharding_islands()
+    batch_specs = {str(specs["batch"]) for specs in islands.values()}
+    assert len(batch_specs) == 1, batch_specs
+
+
+def test_island_specs_unknown_island():
+    with pytest.raises(ValueError):
+        island_specs("nope")
+
+
+def test_resolve_model_axis():
+    canonical = SpecLayout(data=2, tp=4).mesh()
+    legacy = mx.parallel.make_mesh({"data": 2, "expert": 4})
+    assert resolve_model_axis(canonical, "expert") == "tp"
+    assert resolve_model_axis(legacy, "expert") == "expert"
+
+
+def test_moe_default_axis_on_canonical_mesh():
+    """moe_apply with no axis arg runs on a canonical mesh (the old
+    default hard-coded 'expert', which no canonical mesh carries)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.moe import moe_apply, moe_init
+    mesh = SpecLayout(data=2, tp=4).mesh()
+    rng = np.random.RandomState(3)
+    params = moe_init(rng, 16, 32, 8)
+    x = rng.normal(0, 1, (64, 16)).astype(np.float32)
+    out_plain, _ = moe_apply(params, x, capacity_factor=8.0)
+    out_mesh, _ = jax.jit(
+        lambda p, xx: moe_apply(p, xx, capacity_factor=8.0,
+                                mesh=mesh))(params, x)
+    np.testing.assert_allclose(np.asarray(out_mesh),
+                               np.asarray(out_plain), rtol=2e-5,
+                               atol=1e-5)
+
+
+# --------------------------------------------------- Module FSDP binding
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=512, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=8, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _iter(n=64, d=784, classes=8, batch=16):
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    Y = rng.randint(0, classes, (n,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=batch)
+
+
+def test_fsdp_fit_shards_params_and_states():
+    lo = SpecLayout(data=2, fsdp=4, min_shard_bytes=1 << 16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), layout=lo)
+    with mx.profiler.counter_delta() as d:
+        mod.fit(_iter(), num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.init.Uniform(0.05))
+    w = mod._exec.arg_dict["fc1_weight"].data
+    assert "fsdp" in str(w.sharding.spec)
+    # ZeRO: per-device resident = full/4
+    shard = max(s.data.nbytes for s in w.addressable_shards)
+    assert shard * 4 == w.nbytes
+    # optimizer state follows the parameter layout
+    for leaf in jax.tree_util.tree_leaves(mod._fused_states["fc1_weight"]):
+        assert leaf.sharding.spec == w.sharding.spec
+    # the batch shards over BOTH dp axes
+    assert mod._batch_sharding is not None
+    assert d.all().get("loop_recompile", 0) == 0
+
+
+def test_fit_layout_kwarg_routes_set_layout():
+    lo = SpecLayout(data=4, fsdp=2, min_shard_bytes=1 << 16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(), num_epoch=1, optimizer="sgd", layout=lo,
+            initializer=mx.init.Uniform(0.05))
+    assert mod._layout == lo
+    assert dict(zip(mod._mesh.axis_names, mod._mesh.devices.shape)) == \
+        {"data": 4, "fsdp": 2, "tp": 1}
+
+
+def test_explicit_param_shardings_beat_the_layout():
+    lo = SpecLayout(data=2, fsdp=4, min_shard_bytes=0)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), layout=lo,
+                        param_shardings={"fc1_weight": P(None, None)})
+    mod.bind(data_shapes=[("data", (16, 784))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(mx.init.Uniform(0.05))
+    assert str(mod._exec.arg_dict["fc1_weight"].data.sharding.spec) == \
+        str(P(None, None))
+    # un-overridden params still follow the layout
+    assert "fsdp" in str(mod._sharding_for("fc2_weight").spec) or \
+        mod._sharding_for("fc2_weight").spec == P()
+
+
+def test_set_layout_errors():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(MXNetError):
+        mod.set_layout(object())
+    with pytest.raises(MXNetError):
+        mx.mod.Module(_mlp(), context=mx.cpu(),
+                      mesh_shape={"data": 8},
+                      layout=SpecLayout(data=8))
+    lo = SpecLayout(data=8)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), layout=lo)
+    mod.bind(data_shapes=[("data", (16, 784))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.set_layout(lo)                       # same layout: idempotent
+    with pytest.raises(MXNetError):
+        mod.set_layout(SpecLayout(data=2, fsdp=4))
+
+
+def test_indivisible_batch_fails_naming_the_input():
+    lo = SpecLayout(data=2, fsdp=4)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), layout=lo)
+    with pytest.raises(MXNetError, match="data"):
+        mod.bind(data_shapes=[("data", (12, 784))],
+                 label_shapes=[("softmax_label", (12,))])
+
+
+# ------------------------------------- parity + checkpoint reshard drill
+
+
+def _lookup_net():
+    """One-hot lookup regression (the elastic drill's exact model):
+    every reduction has exactly one nonzero contributor, so params are
+    bit-identical across ANY layout."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, no_bias=True,
+                               name="lut")
+    return mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("label"),
+                                         name="reg")
+
+
+def _lookup_iter():
+    x = np.eye(64, dtype=np.float32)[np.arange(64) % 64]
+    rng = np.random.RandomState(3)
+    y = rng.uniform(-1, 1, (64, 4)).astype(np.float32)
+    return mx.io.NDArrayIter({"data": x}, {"label": y}, batch_size=8)
+
+
+def _train_lookup(layout):
+    mx.random.seed(5)
+    mod = mx.mod.Module(_lookup_net(), context=mx.cpu(),
+                        data_names=("data",), label_names=("label",),
+                        layout=layout)
+    mod.fit(_lookup_iter(), num_epoch=2, eval_metric="mse",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9})
+    arg, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in arg.items()}
+
+
+def test_dp_vs_fsdp_bit_identical_on_exact_model():
+    w_dp = _train_lookup(SpecLayout(data=8))
+    w_fsdp = _train_lookup(SpecLayout(data=2, fsdp=4,
+                                      min_shard_bytes=0))
+    for k in w_dp:
+        np.testing.assert_array_equal(w_dp[k], w_fsdp[k], err_msg=k)
+
+
+def test_checkpoint_reshards_through_the_layout():
+    """Save under dp2 x fsdp4, reshard-on-load through a DIFFERENT
+    SpecLayout onto 4 devices — same resolver funnel as the bind, param
+    and optimizer-state bytes intact."""
+    lo8 = SpecLayout(data=2, fsdp=4, min_shard_bytes=1 << 16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), layout=lo8)
+    d = tempfile.mkdtemp(prefix="layout_ck")
+    mx.random.seed(9)
+    mod.fit(_iter(), num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Uniform(0.05),
+            checkpoint=mx.checkpoint.CheckpointConfig(d, period_epochs=1))
+    lo4 = SpecLayout(data=2, fsdp=2, min_shard_bytes=1 << 16)
+    mesh4 = lo4.mesh(devices=jax.devices()[:4])
+    _path, tensors, _mf = mx.checkpoint.load_latest(d, mesh=mesh4,
+                                                    layout=lo4)
+    w = tensors["arg:fc1_weight"]
+    assert "fsdp" in str(w.sharding.spec)
+    assert len(w.sharding.device_set) == 4
+    np.testing.assert_array_equal(
+        np.asarray(w), mod._exec.arg_dict["fc1_weight"].asnumpy())
+    st = tensors.get("opt:fc1_weight")
+    if st is not None:
+        assert "fsdp" in str(st.sharding.spec)
+
+
+def test_obs_report_carries_mesh_shape():
+    lo = SpecLayout(data=2, fsdp=4, min_shard_bytes=1 << 16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), layout=lo)
+    mod.fit(_iter(), num_epoch=1, optimizer="sgd",
+            initializer=mx.init.Uniform(0.05))
+    rep = mx.obs.report()
+    ours = [e for e in rep["executors"]
+            if e.get("mesh") == {"data": 2, "fsdp": 4, "tp": 1}]
+    assert ours, rep["executors"]

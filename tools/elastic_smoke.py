@@ -57,6 +57,25 @@ SEED = 5
 ATTEMPT_FAULTS = {0: "fit.batch@12:sigterm", 1: "fit.batch@6:sigterm"}
 WORLD_SCHEDULE = [8, 4, 2]
 
+# --fsdp mode (ISSUE 14 acceptance drill): the same kill/reshard/resume
+# sequence with the unified SpecLayout — params + optimizer states
+# sharded over the fsdp axis at every world size; the checkpoint
+# reshards 8 -> 4 -> 2 through the SAME layout funnel the bind uses.
+# Env-carried so the supervisor's children inherit the mode.
+FSDP_ENV = "MXNET_TPU_SMOKE_FSDP"
+FSDP_WORLDS = {8: (2, 4), 4: (2, 2), 2: (1, 2), 1: None}
+
+
+def _fsdp_layout(ndev):
+    """dp x fsdp SpecLayout for this world size (None = plain dp).
+    min_shard_bytes=0: the drill's lut weight is tiny — the point is
+    the sharding machinery, not the HBM savings."""
+    shape = FSDP_WORLDS.get(ndev)
+    if shape is None:
+        return None
+    from mxnet_tpu.parallel import SpecLayout
+    return SpecLayout(data=shape[0], fsdp=shape[1], min_shard_bytes=0)
+
 
 def _data():
     """One-hot lookup samples: row i is e_{i mod FEAT}; every batch of 8
@@ -86,9 +105,11 @@ def _train(ckpt_dir=None, out_path=None, check_recompiles=False):
     ndev = len(jax.devices())
     X, Y = _data()
     it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch_size=BATCH)
+    layout = _fsdp_layout(ndev) if os.environ.get(FSDP_ENV) else None
     mod = mx.mod.Module(_symbol(), context=[mx.cpu(i) for i in range(ndev)]
-                        if ndev > 1 else mx.cpu(),
-                        data_names=("data",), label_names=("label",))
+                        if ndev > 1 and layout is None else mx.cpu(),
+                        data_names=("data",), label_names=("label",),
+                        layout=layout)
     kw = {}
     if ckpt_dir is not None:
         kw["checkpoint"] = mx.checkpoint.CheckpointConfig(
@@ -102,6 +123,17 @@ def _train(ckpt_dir=None, out_path=None, check_recompiles=False):
     mod.fit(it, num_epoch=EPOCHS, eval_metric="mse", optimizer="sgd",
             optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
             **kw)
+    if layout is not None:
+        # the drill must exercise REAL fsdp sharding, not silently
+        # degrade to replicated: weight AND optimizer state shards
+        import jax as _jax
+        w = mod._exec.arg_dict["lut_weight"].data
+        assert layout.fsdp_axis in str(w.sharding.spec), w.sharding
+        assert max(s.data.nbytes for s in w.addressable_shards) \
+            < w.nbytes, "lut_weight not actually sharded"
+        for leaf in _jax.tree_util.tree_leaves(mod._fused_states):
+            assert max(s.data.nbytes for s in leaf.addressable_shards) \
+                < leaf.nbytes, "optimizer state not sharded"
     arg, _aux = mod.get_params()
     w = {k: v.asnumpy() for k, v in arg.items()}
     if out_path is not None:
@@ -148,6 +180,7 @@ def main():
         return _zero_cost()
 
     from mxnet_tpu import elastic
+    fsdp = "--fsdp" in sys.argv
     work = tempfile.mkdtemp(prefix="elastic_smoke_")
     ckpt_base = os.path.join(work, "ckpts")
     base_npz = os.path.join(work, "baseline.npz")
@@ -155,6 +188,9 @@ def main():
     env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
     env.pop("MXNET_TPU_FAULTS", None)
     env.pop("MXNET_TPU_CKPT_TEST_CRASH", None)
+    env.pop(FSDP_ENV, None)
+    if fsdp:
+        env[FSDP_ENV] = "1"
 
     # ---- uninterrupted 8-device baseline --------------------------------
     flags = "--xla_force_host_platform_device_count=8"
@@ -182,18 +218,22 @@ def main():
     assert set(ref) == set(got), (sorted(ref), sorted(got))
     for k in sorted(ref):
         np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
-    print("kill->reshard->resume parity: 8 -> 4 -> 2 devices, "
-          "params bit-identical to the uninterrupted 8-device run")
+    print("kill->reshard->resume parity: 8 -> 4 -> 2 devices%s, "
+          "params bit-identical to the uninterrupted 8-device run"
+          % (" (dp x fsdp layout, sharded params + opt states)"
+             if fsdp else ""))
 
-    # ---- knobs-off zero-cost gate ---------------------------------------
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--zero-cost"],
-        env={**env, "XLA_FLAGS": flags}, capture_output=True, text=True,
-        timeout=300)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "ZERO-COST-OK" in proc.stdout
+    # ---- knobs-off zero-cost gate (plain mode only: the fsdp drill's
+    # zero-cost story is the multichip smoke's no-layout gate) ----------
+    if not fsdp:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--zero-cost"],
+            env={**env, "XLA_FLAGS": flags}, capture_output=True,
+            text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ZERO-COST-OK" in proc.stdout
 
-    print("ELASTIC-DRILL-OK")
+    print("ELASTIC-FSDP-DRILL-OK" if fsdp else "ELASTIC-DRILL-OK")
     return 0
 
 

@@ -1,0 +1,153 @@
+"""Grouped-optimizer-update sweep (the PR 9 close-out lever, landed):
+bind + first-step wall of a deep scanned transformer with
+``MXNET_TPU_GROUP_UPDATE`` on vs off, at L=32 and L=96.
+
+Scan-over-layers already lowers the FORWARD through one ``lax.scan``,
+but the fused step still traced L per-layer optimizer-update copies —
+the residual O(L) program eqns PR 9's close-out note flagged. Grouping
+updates each per-layer parameter family as ONE vmapped body over the
+stacked ``(L, ...)`` arrays, so the update traces once per family.
+
+Each arm runs in a fresh subprocess (clean jax caches); results merge
+into ``BENCH_compile_time.json`` under ``"grouped_update"`` next to the
+PR 9 scan sweep. Also records the fused-step jaxpr equation counts both
+ways — the deterministic, box-speed-independent form of the claim.
+
+Usage: python tools/perf/group_update_sweep.py [--layers 32,96] [--out
+BENCH_compile_time.json]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_CHILD = r"""
+import json, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+
+L = int(sys.argv[1])
+group = sys.argv[2] == "1"
+mx.config.set("MXNET_TPU_GROUP_UPDATE", group)
+mx.config.set("MXNET_TPU_SCAN_LAYERS", "auto")
+
+D, H, T, V, B = 128, 4, 64, 256, 4
+sym = transformer.get_symbol(vocab_size=V, num_layers=L, d_model=D,
+                             n_heads=H, seq_len=T)
+rng = np.random.RandomState(0)
+x = rng.randint(0, V, (B, T)).astype(np.float32)
+y = rng.randint(0, V, (B, T)).astype(np.float32)
+
+import jax
+jax.jit(lambda v: v * 2)(np.ones(4))    # warm jax itself
+
+t0 = time.perf_counter()
+mod = mx.mod.Module(sym, context=mx.cpu(0))
+mod.bind(data_shapes=[("data", (B, T))],
+         label_shapes=[("softmax_label", (B, T))])
+mod.init_params(mx.init.Xavier())
+mod.init_optimizer(optimizer="adam",
+                   optimizer_params={"learning_rate": 0.01})
+bind_secs = time.perf_counter() - t0
+
+# deterministic form: count fused-step jaxpr equations both ways
+params = {n: mod._exec.arg_dict[n].data
+          for n in mod._param_names}
+states = mod._fused_states
+aux = {n: a.data for n, a in mod._exec.aux_dict.items()}
+inputs = {n: mod._exec.arg_dict[n].data
+          for n in ("data", "softmax_label")}
+import jax.numpy as jnp
+jaxpr = jax.make_jaxpr(
+    lambda *a: mod._fused_jit.__wrapped__(*a))(
+    params, states, aux, inputs, {}, jax.random.PRNGKey(0),
+    jnp.float32(0.01), jnp.int32(1))
+n_eqns = len(jaxpr.jaxpr.eqns)
+
+t0 = time.perf_counter()
+db = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+mod._fit_step(db)
+jax.block_until_ready(mod._exec.arg_dict["lm_head_weight"].data)
+first_step_secs = time.perf_counter() - t0
+
+print(json.dumps({
+    "layers": L, "grouped": group,
+    "bind_secs": round(bind_secs, 3),
+    "first_step_secs": round(first_step_secs, 3),
+    "fused_step_eqns": n_eqns,
+    "update_groups": mx.profiler.gauges().get("fused_update_groups"),
+}))
+"""
+
+
+def _arm(layers, group):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(layers), "1" if group else "0"],
+        capture_output=True, text=True, env=env, timeout=900)
+    if proc.returncode != 0:
+        raise SystemExit("arm L=%d group=%s failed:\n%s\n%s"
+                         % (layers, group, proc.stdout[-2000:],
+                            proc.stderr[-3000:]))
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit("arm produced no JSON")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", default="32,96")
+    ap.add_argument("--out", default=os.path.join(ROOT,
+                                                  "BENCH_compile_time.json"))
+    args = ap.parse_args()
+
+    configs = []
+    for L in (int(s) for s in args.layers.split(",")):
+        on = _arm(L, True)
+        off = _arm(L, False)
+        rec = {
+            "layers": L,
+            "bind_plus_first_step_grouped":
+                round(on["bind_secs"] + on["first_step_secs"], 2),
+            "bind_plus_first_step_per_param":
+                round(off["bind_secs"] + off["first_step_secs"], 2),
+            "speedup": round(
+                (off["bind_secs"] + off["first_step_secs"])
+                / max(1e-9, on["bind_secs"] + on["first_step_secs"]), 2),
+            "fused_step_eqns_grouped": on["fused_step_eqns"],
+            "fused_step_eqns_per_param": off["fused_step_eqns"],
+            "eqn_ratio": round(off["fused_step_eqns"]
+                               / max(1, on["fused_step_eqns"]), 2),
+            "update_groups": on["update_groups"],
+        }
+        configs.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    try:
+        with open(args.out) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {"metric": "compile_time_levers", "configs": []}
+    doc["grouped_update"] = {
+        "note": "MXNET_TPU_GROUP_UPDATE on-vs-off under scan-over-layers "
+                "(cpu-host; adam, d_model=128, seq=64): the fused step's "
+                "per-layer optimizer-update eqns collapse to one vmapped "
+                "body per parameter family",
+        "configs": configs,
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print("updated %s" % args.out)
+
+
+if __name__ == "__main__":
+    main()

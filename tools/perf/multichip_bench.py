@@ -1,0 +1,440 @@
+"""Multi-chip training bench: REAL ``fit`` runs per mesh shape
+(ISSUE 14 — the MULTICHIP dryruns promoted to benched end-to-end runs).
+
+For each mesh shape of the unified ``data x fsdp x tp`` SpecLayout
+(pure-dp, dp x fsdp, dp x tp, dp x fsdp x tp — the 8-device virtual
+mesh, or real TPU shapes when hardware is reachable) this driver runs a
+real ``Module.fit`` and records:
+
+* **steps/s + MFU** from the always-on mx.obs accounting (MFU per mesh
+  shape — the obs record now carries the mesh; peak FLOP/s comes from
+  the TPU device-kind table, or a calibrated host-matmul peak on CPU so
+  the number is meaningful rather than fabricated);
+* **per-axis collective bytes** of the actual fused-step executable
+  (the PR 8 analyzer's collective walk over the post-GSPMD HLO),
+  cross-checked against the analytic comm model where one is exact:
+  - pure dp: the gradient all-reduce over ``data`` moves exactly the
+    grad-bearing parameter bytes;
+  - dp x tp: the same reduction shrinks to ``bytes/tp_shards`` per
+    tensor-parallel parameter (each device reduces only its shard);
+  both must agree within +-25% (BENCH gate). The fsdp arms record the
+  full per-axis table too; at bench batch sizes GSPMD legitimately
+  prefers resharding the (small) activations over gathering the (large)
+  weights, so the fsdp-axis gate is the RESIDENT-bytes claim below, not
+  a gather-bytes prediction.
+* **per-device resident param+state bytes**, proving the FSDP axis
+  recovers what the analyzer's ``fsdp-opportunity`` audit promised:
+  dp x fsdp residency ~= replicated/fsdp (within padding + the
+  min-shard-bytes threshold), with the audited recovered-bytes number
+  validated against the measured drop.
+
+Output: one JSON line per shape as it completes (wedge-proof, the
+bench.py protocol), then the merged record — written to
+``BENCH_multichip.json`` when ``--out`` is given.
+
+``--smoke`` is the CI ``multichip`` job: dp x fsdp only, hard deadline,
+asserts nonzero steps/s, ``check_islands`` zero findings, the comm
+cross-check, the residency ratio, and the zero-cost gate (a plain fit
+in a subprocess never imports ``parallel.layout`` and moves no new
+counters).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, ROOT)
+
+# the virtual-mesh rig: 8 CPU devices unless real accelerators exist
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+
+B, DIN, HIDDEN, D2, NCLASS = 64, 1024, 2048, 1024, 16
+NSAMP, EPOCHS = 512, 3
+COMM_TOL = 0.25
+
+
+def _build_symbol():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=HIDDEN, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=D2, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=NCLASS, name="head")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _calibrated_peak():
+    """Per-device peak FLOP/s: the TPU device-kind table when known,
+    else a measured host matmul rate — a real denominator, so the CPU
+    fallback MFU is 'fraction of this host's matmul peak', not a
+    fabricated number. The 8 virtual CPU devices all share ONE host's
+    cores, and the MFU gauge multiplies the per-device peak by the
+    device count — so the host rate is split across the virtual devices
+    to keep that product the true host peak."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.obs import mfu as _mfu
+    peak = _mfu.peak_flops(jax.devices()[0].device_kind)
+    if peak:
+        return peak, "device-kind table"
+    n = 1024
+    a = jnp.asarray(np.random.RandomState(0).rand(n, n), jnp.float32)
+    f = jax.jit(lambda x: x @ x)
+    f(a).block_until_ready()
+    t0 = time.perf_counter()
+    iters = 8
+    for _ in range(iters):
+        a = f(a)
+    a.block_until_ready()
+    dt = time.perf_counter() - t0
+    host = 2 * n ** 3 * iters / dt
+    return host / len(jax.devices()), "calibrated-host-matmul/n_dev"
+
+
+def _resident_bytes(mod):
+    """Per-device resident bytes of parameters + optimizer states (what
+    FSDP is supposed to shrink): sum of ONE device's shard of every
+    array."""
+    import jax
+    import numpy as np
+
+    def shard_bytes(arr):
+        shp = arr.sharding.shard_shape(arr.shape)
+        return int(np.prod(shp, dtype=np.int64)) * arr.dtype.itemsize
+
+    params = 0
+    for n in mod._param_names:
+        params += shard_bytes(mod._exec.arg_dict[n].data)
+    states = 0
+    for leaf in jax.tree_util.tree_leaves(mod._fused_states or {}):
+        states += shard_bytes(leaf)
+    return params, states
+
+
+def _fused_call_args(mod):
+    """Reconstruct the fused step's call signature (exactly what run()
+    passes) so the executable can be lowered for the collective walk."""
+    import jax
+    import jax.numpy as jnp
+    ex = mod._exec
+    pnames = [n for n in mod._param_names
+              if mod._grad_req.get(n, "null") != "null"]
+    params = {n: ex.arg_dict[n].data for n in pnames}
+    # inputs must be batch-sharded exactly as the fit loop places them
+    # (fit's epoch-end set_params re-placed the input buffers replicated
+    # — lowering with THOSE would partition a collective-free program)
+    inputs = {}
+    for n in (set(mod._data_names) | set(mod._label_names)
+              | set(mod._state_names)):
+        if n not in ex.arg_dict:
+            continue
+        val = ex.arg_dict[n].data
+        if mod._batch_sharding is not None:
+            import jax as _jax
+            val = _jax.device_put(val, mod._batch_sharding)
+        inputs[n] = val
+    frozen = {n: ex.arg_dict[n].data for n in mod._param_names
+              if n not in pnames}
+    aux = {n: a.data for n, a in ex.aux_dict.items()}
+    key = jax.random.fold_in(ex._base_key, 1)
+    return (params, mod._fused_states, aux, inputs, frozen, key,
+            jnp.asarray(0.1, jnp.float32), jnp.asarray(1, jnp.int32))
+
+
+def _collective_walk(mod):
+    """Per-axis collective buffer/link bytes of the REAL fused-step
+    program (the analyzer's PR 8 machinery over the lowered HLO)."""
+    from mxnet_tpu.analysis.sharding_passes import collectives_from_hlo
+    txt = mod._fused_jit.lower(*_fused_call_args(mod)).compile().as_text()
+    per_axis = {}
+    for rec in collectives_from_hlo(txt, mesh=mod._mesh):
+        k = "x".join(rec["axes"]) or "<unattributed>"
+        agg = per_axis.setdefault(k, {"bytes": 0, "link_bytes": 0,
+                                      "count": 0})
+        agg["bytes"] += rec["bytes"]
+        agg["link_bytes"] += rec["link_bytes"]
+        agg["count"] += 1
+    return per_axis
+
+
+def _comm_model(mod, layout):
+    """The analytic side of the cross-check: per-axis expectations that
+    are EXACT by construction (gradient reductions), keyed by the axis
+    group GSPMD emits them under. Activation collectives and GSPMD's
+    cost-based resharding choices are deliberately not modeled — the
+    gate covers only the modeled axes."""
+    from mxnet_tpu.analysis.sharding_passes import _spec_axes
+    if layout.fsdp > 1:
+        # fsdp arms: GSPMD picks between weight-gather and
+        # activation-reshard strategies (and reduce-scatter vs
+        # all-reduce, merged axis groups) on cost — no closed-form
+        # per-axis byte prediction holds across batch sizes. Their
+        # gated claim is the resident-bytes one; the full measured
+        # per-axis table is still recorded.
+        return {}
+    fsdp_ax = layout.fsdp_axis
+    sizes = {str(a): int(s) for a, s in
+             zip(mod._mesh.axis_names, mod._mesh.devices.shape)}
+    dp_axes = [ax for ax in (layout.data_axis, fsdp_ax)
+               if sizes.get(ax, 1) > 1]
+    model = {}
+    for n in mod._param_names:
+        if mod._grad_req.get(n, "null") == "null":
+            continue
+        arr = mod._exec.arg_dict[n].data
+        spec_axes = set(_spec_axes(arr.sharding.spec))
+        shards = 1
+        for ax in spec_axes:
+            shards *= sizes.get(ax, 1)
+        # this param's gradient reduces over the dp axes it is NOT
+        # already sharded over; the reduce moves its SHARD bytes
+        reduce_axes = tuple(ax for ax in dp_axes if ax not in spec_axes)
+        if not reduce_axes:
+            continue
+        key = "x".join(reduce_axes)
+        model[key] = model.get(key, 0) + arr.nbytes // shards
+    return model
+
+
+def run_shape(tag, layout, peak, peak_source, audit_recovered=None):
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rec = {"shape": tag, "mesh": layout.axes(), "batch": B,
+           "peak_flops_per_device": peak, "peak_source": peak_source}
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (NSAMP, DIN)).astype(np.float32)
+    Y = rng.randint(0, NCLASS, (NSAMP,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=B)
+
+    mx.random.seed(13)
+    mx.config.set("MXNET_TPU_OBS_PEAK_FLOPS",
+                  peak if peak_source != "device-kind table" else 0.0)
+    t0 = time.perf_counter()
+    # the single context is a placeholder — with a layout bound, bind
+    # builds the mesh over ALL default-backend devices (TPU when
+    # attached, the 8-device virtual CPU mesh otherwise)
+    mod = mx.mod.Module(_build_symbol(), context=mx.cpu(), layout=layout)
+    rc0 = mx.profiler.counters().get("loop_recompile", 0)
+    mod.fit(it, num_epoch=EPOCHS, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Uniform(0.05), eval_metric="acc")
+    rec["fit_wall_secs"] = round(time.perf_counter() - t0, 2)
+    rec["loop_recompile"] = \
+        mx.profiler.counters().get("loop_recompile", 0) - rc0
+
+    # obs: steps/s + MFU per mesh shape (one collect closes the window
+    # that opened at the warmup step)
+    rep = mx.obs.report()
+    ours = [e for e in rep["executors"] if e.get("mesh")]
+    if ours:
+        e = max(ours, key=lambda r: r.get("steps_per_sec") or 0)
+        rec["steps_per_sec"] = round(e["steps_per_sec"], 3) \
+            if e.get("steps_per_sec") else None
+        rec["mfu"] = round(e["mfu"], 5) if e.get("mfu") is not None \
+            else None
+        rec["flops_per_step"] = e.get("flops_per_step")
+
+    # the real executable's collectives vs the analytic model
+    measured = _collective_walk(mod)
+    model = _comm_model(mod, layout)
+    rec["comm_per_axis_bytes"] = {k: v["bytes"]
+                                  for k, v in sorted(measured.items())}
+    rec["comm_per_axis_link_bytes"] = {
+        k: v["link_bytes"] for k, v in sorted(measured.items())}
+    rec["comm_model_bytes"] = model
+    checks = {}
+    for axis, want in model.items():
+        got = measured.get(axis, {}).get("bytes", 0)
+        checks[axis] = {"measured": got, "model": want,
+                        "ratio": round(got / want, 3) if want else None,
+                        "ok": bool(want and
+                                   abs(got - want) <= COMM_TOL * want)}
+    rec["comm_check"] = checks
+
+    res_p, res_s = _resident_bytes(mod)
+    rec["resident_param_bytes_per_device"] = res_p
+    rec["resident_state_bytes_per_device"] = res_s
+    rec["resident_param_state_bytes_per_device"] = res_p + res_s
+    if audit_recovered is not None:
+        rec["audit_recovered_bytes_per_device_full_fsdp"] = audit_recovered
+    mx.config.reset("MXNET_TPU_OBS_PEAK_FLOPS")
+    return rec, mod
+
+
+def _audit_fsdp_opportunity(mod):
+    """The analyzer's fsdp-opportunity numbers for a pure-dp module —
+    the promise the dp x fsdp arm must cash."""
+    report = mod.analyze(sharding=True, collectives=False)
+    total = 0
+    for f in report.findings:
+        if f.code == "fsdp-opportunity":
+            total += int(f.detail.get("recovered_bytes_per_device", 0))
+    return total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="write the merged record here "
+                         "(e.g. BENCH_multichip.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: dp x fsdp only + assertions + "
+                         "zero-cost subprocess")
+    args = ap.parse_args()
+
+    import jax
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        print(json.dumps({"skipped": "need 8 devices, have %d" % n_dev}))
+        return 0
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SpecLayout
+
+    peak, peak_source = _calibrated_peak()
+
+    shapes = [("dp%d" % n_dev, SpecLayout(data=n_dev)),
+              ("dp2xfsdp%d" % (n_dev // 2), SpecLayout(data=2,
+                                                       fsdp=n_dev // 2)),
+              ("dp2xtp%d" % (n_dev // 2), SpecLayout(data=2,
+                                                     tp=n_dev // 2)),
+              ("dp2xfsdp2xtp2", SpecLayout(data=2, fsdp=2, tp=2))]
+    if args.smoke:
+        shapes = [shapes[0], shapes[1]]
+
+    records = {}
+    audit_recovered = None
+    dp_resident = None
+    deadline = time.monotonic() + float(os.environ.get(
+        "MULTICHIP_BENCH_TIMEOUT", "900"))
+    for tag, layout in shapes:
+        if time.monotonic() > deadline:
+            records[tag] = {"shape": tag, "error": "bench deadline"}
+            print(json.dumps(records[tag]), flush=True)
+            continue
+        rec, mod = run_shape(tag, layout, peak, peak_source,
+                             audit_recovered=audit_recovered
+                             if layout.fsdp > 1 else None)
+        if layout.fsdp == 1 and layout.tp == 1:
+            # the pure-dp module is what the fsdp-opportunity audit
+            # speaks about; its promise gates the fsdp arm below
+            audit_recovered = _audit_fsdp_opportunity(mod)
+            dp_resident = rec["resident_param_bytes_per_device"]
+            rec["audit_fsdp_opportunity_bytes_per_device"] = \
+                audit_recovered
+        if layout.fsdp > 1 and layout.tp == 1 and dp_resident:
+            # param-only comparison: the audit speaks about parameters
+            # (states recover the same fraction again — recorded above);
+            # tp arms recover via a different mechanism and are excluded
+            measured_rec = dp_resident - \
+                rec["resident_param_bytes_per_device"]
+            # the audit promises (n_dev-1)/n_dev recovery at FULL fsdp;
+            # scale to THIS layout's (fsdp-1)/fsdp before comparing
+            scaled = None
+            if audit_recovered:
+                scaled = int(audit_recovered
+                             * ((layout.fsdp - 1) / layout.fsdp)
+                             / ((n_dev - 1) / n_dev))
+            rec["fsdp_recovered_bytes_per_device"] = measured_rec
+            rec["fsdp_recovered_vs_audit"] = {
+                "measured": measured_rec, "audit_scaled": scaled,
+                "ratio": round(measured_rec / scaled, 3) if scaled
+                else None}
+        records[tag] = rec
+        print(json.dumps(rec), flush=True)
+
+    merged = {
+        "metric": "multichip_fit",
+        "n_devices": n_dev,
+        "platform": jax.devices()[0].device_kind,
+        "model": "mlp %d-%d-%d-%d, batch %d, sgd+momentum, %d epochs x "
+                 "%d batches" % (DIN, HIDDEN, D2, NCLASS, B, EPOCHS,
+                                 NSAMP // B),
+        "peak_flops_per_device": peak,
+        "peak_source": peak_source,
+        "comm_tolerance": COMM_TOL,
+        "shapes": records,
+    }
+    print(json.dumps(merged), flush=True)
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.out)
+
+    if args.smoke:
+        return _smoke_asserts(records, n_dev)
+    return 0
+
+
+def _smoke_asserts(records, n_dev):
+    import mxnet_tpu as mx
+    dp = records["dp%d" % n_dev]
+    fsdp = records["dp2xfsdp%d" % (n_dev // 2)]
+    # 1. real benched fit: nonzero steps/s, zero steady-state recompiles
+    for rec in (dp, fsdp):
+        assert rec.get("steps_per_sec"), \
+            "no steps/s for %s: %s" % (rec.get("shape"), rec)
+        assert rec["loop_recompile"] == 0, rec
+    # 2. comm cross-check on every modeled axis
+    for rec in (dp, fsdp):
+        for axis, chk in rec["comm_check"].items():
+            assert chk["ok"], "comm model mismatch on %s/%s: %s" \
+                % (rec["shape"], axis, chk)
+    assert dp["comm_check"], "pure-dp must model its data-axis reduce"
+    # 3. FSDP residency: ~1/fsdp of replicated for the sharded bytes
+    rva = fsdp["fsdp_recovered_vs_audit"]
+    assert rva["audit_scaled"] and rva["ratio"] is not None, rva
+    assert abs(rva["ratio"] - 1.0) <= 0.25, \
+        "fsdp recovered bytes diverge from the audit promise: %s" % rva
+    # 4. islands: zero cross-island disagreements on the canonical mesh
+    from mxnet_tpu.analysis import check_islands
+    from mxnet_tpu.parallel import SpecLayout, sharding_islands
+    rep = check_islands(sharding_islands(),
+                        mesh=SpecLayout(data=2, fsdp=2, tp=2).mesh())
+    assert len(rep.findings) == 0, \
+        "island disagreement: %s" % [f.format() for f in rep.findings]
+    # 5. zero-cost gate: a PLAIN fit (no layout) in a fresh process
+    # never imports parallel.layout and moves no layout/group counters
+    code = r"""
+import sys
+import numpy as np
+import mxnet_tpu as mx
+rng = np.random.RandomState(0)
+it = mx.io.NDArrayIter(rng.uniform(-1, 1, (32, 16)).astype(np.float32),
+                       rng.randint(0, 4, (32,)).astype(np.float32),
+                       batch_size=8)
+net = mx.sym.SoftmaxOutput(
+    mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=4),
+    name='softmax')
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=1, optimizer='sgd',
+        initializer=mx.init.Uniform(0.05))
+assert 'mxnet_tpu.parallel.layout' not in sys.modules, \
+    'layout imported in a plain fit'
+c = mx.profiler.counters()
+assert not c.get('fused_update_grouped'), c
+print('ZERO-COST-OK')
+"""
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0 and "ZERO-COST-OK" in proc.stdout, \
+        proc.stdout + proc.stderr
+    print("MULTICHIP-SMOKE-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
