@@ -39,7 +39,7 @@ import numpy as np
 from .findings import Report, Severity
 from .graph_passes import GraphContext, _nelem, _node_flops, graph_pass
 
-__all__ = ["analyze_program_memory", "parse_bytes",
+__all__ = ["analyze_program_memory", "parse_bytes", "check_reservation",
            "REMAT_CHEAP_FLOPS_PER_BYTE", "REMAT_TOP_N"]
 
 # recompute cost ceiling for a "cheap" activation: recomputing must cost
@@ -330,6 +330,46 @@ def budget_pass(ctx: GraphContext, report: Report) -> None:
         "the batch; strict mode rejects this bind before any compile)"
         % (peak / 1e6, budget / 1e6, named),
         detail={"budget_bytes": budget, "peak_bytes": peak})
+
+
+def check_reservation(name: str, nbytes: int,
+                      detail: str = "") -> Dict[str, Any]:
+    """Audit a long-lived device reservation (the serve KV cache) against
+    ``MXNET_TPU_ANALYZE_HBM_BUDGET`` — the runtime twin of the bind-time
+    ``hbm-budget`` pass for memory claimed OUTSIDE a graph bind.
+
+    Returns ``{"budget_bytes", "reserved_bytes", "fits"}`` (budget 0 =
+    unset, always fits). Over budget: ``MXNET_TPU_ANALYZE=strict`` raises
+    :class:`~mxnet_tpu.base.MXNetError` NAMING the reservation before any
+    device allocation; ``warn`` logs a WARNING with the same message.
+    Callers gate the import of this module on the analyze knob, so the
+    analyzer stays unimported when analysis is off.
+    """
+    import logging
+    from .. import config as _config
+    from ..base import MXNetError
+    raw = _config.get("MXNET_TPU_ANALYZE_HBM_BUDGET")
+    try:
+        budget = parse_bytes(raw)
+    except ValueError as exc:
+        logging.getLogger(__name__).warning(
+            "MXNET_TPU_ANALYZE_HBM_BUDGET=%r is unparseable (%s) — "
+            "reservation %r is NOT being audited", raw, exc, name)
+        return {"budget_bytes": 0, "reserved_bytes": int(nbytes),
+                "fits": True}
+    out = {"budget_bytes": budget, "reserved_bytes": int(nbytes),
+           "fits": budget <= 0 or int(nbytes) <= budget}
+    if out["fits"]:
+        return out
+    msg = ("reservation %r (%s%.3g MB) exceeds MXNET_TPU_ANALYZE_HBM_BUDGET"
+           " %.3g MB — shrink max_sequences / the decode bucket set, or "
+           "enable MXNET_TPU_SERVE_KV_INT8"
+           % (name, (detail + ", ") if detail else "",
+              nbytes / 1e6, budget / 1e6))
+    if _config.get("MXNET_TPU_ANALYZE") == "strict":
+        raise MXNetError("hbm-budget: " + msg)
+    logging.getLogger(__name__).warning("hbm-budget: %s", msg)
+    return out
 
 
 # ------------------------------------------------- program-level liveness

@@ -48,7 +48,7 @@ from . import profiler as _profiler
 
 __all__ = [
     "enabled", "supported", "fingerprint", "digest", "load", "store",
-    "install_persistent_cache_fence",
+    "load_or_compile", "install_persistent_cache_fence",
 ]
 
 log = logging.getLogger(__name__)
@@ -205,6 +205,32 @@ def store(name: str, key: str, compiled) -> bool:
         return False
     _profiler.incr_counter("aot_store")
     return True
+
+
+def load_or_compile(name: str, key: str, jitted, *args):
+    """The warm-start recipe the executor forward and fused step
+    hand-roll, as one call: return the cached executable for
+    ``(name, key)`` when present, else seed the cache — lower + compile
+    ``jitted`` on ``args`` with jax's persistent compile cache bypassed
+    (a cache-loaded executable serializes to an unloadable payload) and
+    ``store`` the result.
+
+    Returns ``(compiled, hit)``. Callers keep the first post-``load``
+    invocation on COPIES of donated buffers (a bad cache entry must not
+    invalidate live state — the ``_fused`` discipline). When the cache is
+    off/unsupported the compile still happens (without the bypass), so
+    the caller always gets an executable.
+    """
+    loaded = load(name, key)
+    if loaded is not None:
+        return loaded, True
+    if enabled() is not None and supported():
+        with bypass_persistent_cache():
+            compiled = jitted.lower(*args).compile()
+        store(name, key, compiled)
+    else:
+        compiled = jitted.lower(*args).compile()
+    return compiled, False
 
 
 # ------------------------------------------------- persistent-cache fence

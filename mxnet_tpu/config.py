@@ -111,6 +111,33 @@ register("MXNET_TPU_SERVE_MAX_DELAY_US", int, 2000,
 register("MXNET_TPU_SERVE_QUEUE_BOUND", int, 1024,
          "serve: default admission bound; submit() load-sheds (QueueFull) "
          "when this many requests are already queued")
+register("MXNET_TPU_SERVE_KV_INT8", _parse_bool, False,
+         "serve.GenerativeServer: store the decode KV cache as int8 with "
+         "per-page f32 scales instead of f32 — the cache reservation "
+         "shrinks ~4x (≈2x max resident sequences under a typical "
+         "MXNET_TPU_ANALYZE_HBM_BUDGET once scales and slack are paid), "
+         "at a documented logits tolerance (tests/test_serve_decode.py)")
+register("MXNET_TPU_SERVE_MAX_SEQUENCES", int, 8,
+         "serve.GenerativeServer: default max resident decode sequences "
+         "(the KV cache's preallocated slot count; also the decode "
+         "batch width). Overridden by the max_sequences argument")
+register("MXNET_TPU_SERVE_PREFILL_TOKENS", int, 2048,
+         "serve.GenerativeServer: prefill token budget per scheduler "
+         "iteration — joins admitted between two decode steps may "
+         "prefill at most this many (bucket-padded) prompt tokens, so "
+         "a burst of long prompts cannot starve the running batch's "
+         "inter-token latency")
+register("MXNET_TPU_SERVE_DECODE_BUCKETS", str, "",
+         "serve.GenerativeServer: explicit comma-separated decode "
+         "sequence-length bucket ladder (e.g. '128,256,512'); empty = "
+         "powers of two from the page size up to the model's max "
+         "sequence length. Every bucket must be a multiple of the KV "
+         "page size (the int8 per-page scale grid)")
+register("MXNET_TPU_SERVE_KV_PAGE", int, 16,
+         "serve.GenerativeServer: KV-cache page size in tokens — slot "
+         "capacity is allocated and freed page-at-a-time, and int8 mode "
+         "keeps one quantization scale per page. Must divide every "
+         "decode bucket")
 def _parse_analyze_mode(v) -> str:
     s = str(v).strip().lower()
     if s in ("", "0", "off", "false", "no", "none"):

@@ -39,6 +39,13 @@ leader.die           fit loop, each batch start    hostkill
                      (arm on the leader's host)
 dist.kv              dist.kv_set / dist.kv_get     raise
 serve.submit         InferenceServer.submit        raise
+serve.decode         GenerativeServer, before      raise
+                     each decode step (kills ONE
+                     sequence's stream, never the
+                     co-resident batch)
+serve.evict          GenerativeServer, during      raise
+                     sequence eviction (pages are
+                     still freed — no leak)
 ===================  ============================  =====================
 
 Failure kinds: ``eio``/``enospc``/``eintr`` raise the matching
@@ -91,8 +98,8 @@ KINDS = ("eio", "enospc", "eintr", "raise", "sigterm", "sigkill",
 SITES = frozenset((
     "ckpt.arrays_write", "ckpt.after_arrays", "ckpt.after_record",
     "ckpt.after_manifest", "ckpt.before_rename", "ckpt.read_manifest",
-    "ckpt.read_arrays", "fit.batch", "serve.submit", "host.die",
-    "leader.die", "dist.kv",
+    "ckpt.read_arrays", "fit.batch", "serve.submit", "serve.decode",
+    "serve.evict", "host.die", "leader.die", "dist.kv",
 ))
 
 # kinds that model a HOST dying rather than one process failing

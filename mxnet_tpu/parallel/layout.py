@@ -256,6 +256,13 @@ def island_specs(island: str,
         # axis ((B, H, S, D) layout)
         "ring_attention": {"batch": batch,
                            "qkv_seq": P(None, None, model, None)},
+        # generative serving: the decode KV cache shards its head axis
+        # over the model axis ((slots, H, S, D) layout — the serving
+        # analogue of tp-sharded attention heads); the int8 per-page
+        # scale planes (slots, H, n_pages) follow the same head split
+        "serve": {"batch": batch,
+                  "kv_cache": P(None, model, None, None),
+                  "kv_scale": P(None, model, None)},
     }
     if island not in table:
         raise ValueError("unknown sharding island %r (have %s)"

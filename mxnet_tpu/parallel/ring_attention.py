@@ -25,7 +25,8 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 __all__ = ["ring_attention", "ring_self_attention",
-           "local_attention_block", "sharding_island"]
+           "local_attention_block", "chunked_causal_attention",
+           "sharding_island"]
 
 
 def sharding_island():
@@ -54,6 +55,62 @@ def local_attention_block(q, k, v, mask=None, scale=None):
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype))
     return o, l, m
+
+
+def chunked_causal_attention(q, k, v, scale=None, chunk: int = 512):
+    """Single-device blockwise causal attention — the serving prefill's
+    long-context path. Same online-softmax accumulation the ring kernel
+    rotates across devices, applied to local sequence chunks so no
+    (S, S) score matrix ever materializes: for prefill buckets past the
+    chunk size the score working set drops from O(S^2) to
+    O(S * chunk). Strictly-future (q-chunk, kv-chunk) pairs are skipped
+    at trace time (the causal half of the schedule), so the chunk grid
+    is lower-triangular like the ring's causal mask.
+
+    q, k, v: (B, H, S, D); returns (B, H, S, D) in q's dtype.
+    """
+    b, h, s, d = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    if s <= chunk:
+        mask = (jnp.arange(s)[:, None] >= jnp.arange(s)[None, :])[None, None]
+        o, l, m = local_attention_block(q, k, v, mask, scale)
+        return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    if s % chunk:
+        raise ValueError("sequence %d is not a multiple of chunk %d "
+                         "(prefill buckets are pow2 — pick a pow2 chunk)"
+                         % (s, chunk))
+    n = s // chunk
+    outs = []
+    for qi in range(n):
+        q_blk = lax.slice_in_dim(q, qi * chunk, (qi + 1) * chunk, axis=2)
+        q_pos = qi * chunk + jnp.arange(chunk)
+        o_acc = jnp.zeros((b, h, chunk, d), jnp.float32)
+        l_acc = jnp.zeros((b, h, chunk), jnp.float32)
+        m_acc = jnp.full((b, h, chunk), -jnp.inf, jnp.float32)
+        for ki in range(qi + 1):          # causal: only past/diag chunks
+            k_blk = lax.slice_in_dim(k, ki * chunk, (ki + 1) * chunk,
+                                     axis=2)
+            v_blk = lax.slice_in_dim(v, ki * chunk, (ki + 1) * chunk,
+                                     axis=2)
+            if ki == qi:                  # diagonal chunk needs the mask
+                k_pos = ki * chunk + jnp.arange(chunk)
+                mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            else:
+                mask = None
+            o_blk, l_blk, m_blk = local_attention_block(
+                q_blk, k_blk, v_blk, mask, scale)
+            m_new = jnp.maximum(m_acc, m_blk)
+            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            alpha = jnp.exp(jnp.where(jnp.isneginf(m_acc), -jnp.inf,
+                                      m_acc - m_safe))
+            beta = jnp.exp(jnp.where(jnp.isneginf(m_blk), -jnp.inf,
+                                     m_blk - m_safe))
+            o_acc = o_acc * alpha[..., None] + o_blk * beta[..., None]
+            l_acc = l_acc * alpha + l_blk * beta
+            m_acc = m_new
+        outs.append(o_acc / jnp.maximum(l_acc, 1e-30)[..., None])
+    return jnp.concatenate(outs, axis=2).astype(q.dtype)
 
 
 def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,

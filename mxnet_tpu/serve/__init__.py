@@ -12,16 +12,52 @@ steady-state recompiles. See :mod:`.server` for the design and
     server.stats()   # p50/p95/p99, occupancy, per-bucket compiles
     server.close()   # graceful drain
 
+Generative decode (continuous batching over a preallocated bucketed KV
+cache — :mod:`.kv_cache` / :mod:`.decode`):
+
+    gen = mx.serve.GenerativeServer(module, n_heads=8)
+    handle = gen.submit_generate(prompt_ids, max_new_tokens=64)
+    for tok in handle:      # per-token streaming
+        ...
+
 Kill switch: ``MXNET_TPU_SERVE=0`` degrades every ``submit`` to an
 eager per-request forward in the caller thread (the bisection fallback,
 mirroring ``MXNET_TPU_FUSED_TRAINER``).
+
+Zero-cost gate: importing this package does NOT import the decode path
+(:mod:`.kv_cache` / :mod:`.decode`) — those load lazily on first
+``GenerativeServer`` construction or attribute access below, so batch
+serving never pays for generative machinery it doesn't use (CI asserts
+this).
 """
-from .bucketing import BucketSpec
-from .server import (DeadlineExceeded, InferenceServer, QueueFull,
-                     ServeError, ServerClosed, wrap_model)
-from .stats import LatencyStats
+from .bucketing import BucketSpec, decode_buckets
+from .server import (DeadlineExceeded, GenerateHandle, GenerativeServer,
+                     InferenceServer, QueueFull, ServeError, ServerClosed,
+                     wrap_model)
+from .stats import DecodeLatencyStats, LatencyStats
 
 __all__ = [
-    "InferenceServer", "BucketSpec", "LatencyStats", "wrap_model",
+    "InferenceServer", "GenerativeServer", "GenerateHandle", "BucketSpec",
+    "decode_buckets", "LatencyStats", "DecodeLatencyStats", "wrap_model",
     "ServeError", "ServerClosed", "QueueFull", "DeadlineExceeded",
+    "KVCache", "PageLedger", "max_slots_for", "DecodeEngine",
 ]
+
+# lazy decode-path exports: module-level __getattr__ keeps kv_cache /
+# decode unimported until someone actually reaches for them
+_LAZY = {
+    "KVCache": ("kv_cache", "KVCache"),
+    "PageLedger": ("kv_cache", "PageLedger"),
+    "CacheFull": ("kv_cache", "CacheFull"),
+    "max_slots_for": ("kv_cache", "max_slots_for"),
+    "DecodeEngine": ("decode", "DecodeEngine"),
+    "DecodeConfig": ("decode", "DecodeConfig"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module("." + mod, __name__), attr)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

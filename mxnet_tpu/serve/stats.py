@@ -26,7 +26,7 @@ from typing import Dict, Optional
 
 from .. import profiler as _profiler
 
-__all__ = ["LatencyStats"]
+__all__ = ["LatencyStats", "DecodeLatencyStats"]
 
 
 class LatencyStats:
@@ -71,6 +71,35 @@ class LatencyStats:
             "max_ms": round(float(snap["max"]) * 1e3, 4),
             "window": int(n),
         }
+
+
+class DecodeLatencyStats:
+    """The generative-serving latency pair: time-to-first-token and
+    time-per-output-token, each a :class:`LatencyStats` over its own
+    registry histogram (``<name>_ttft_seconds`` / ``<name>_tpot_seconds``
+    — the Prometheus exposition picks both up for free, same as the
+    batch server's ``_latency_seconds``).
+
+    TTFT spans submit → first streamed token (queueing + prefill +
+    first sample); TPOT is the inter-token gap inside steady-state
+    decode — the pair is the standard decomposition because continuous
+    batching trades them off (admitting a join costs resident
+    sequences one prefill of TPOT).
+    """
+
+    def __init__(self, name: str = "serve"):
+        self.name = name
+        self.ttft = LatencyStats(name=name + "_ttft_seconds")
+        self.tpot = LatencyStats(name=name + "_tpot_seconds")
+
+    def reset(self) -> None:
+        self.ttft.reset()
+        self.tpot.reset()
+
+    def snapshot(self) -> Dict[str, Optional[Dict[str, float]]]:
+        """{"ttft": ..., "tpot": ...} — each side a LatencyStats
+        snapshot (or None before its first sample)."""
+        return {"ttft": self.ttft.snapshot(), "tpot": self.tpot.snapshot()}
 
 
 def monotonic() -> float:

@@ -51,3 +51,33 @@ def test_serve_bench_quick(tmp_path):
         loaded = json.load(f)
     assert loaded["bench"] == "serving"
     assert loaded["results"]["mlp"]["served_rps"] == r["served_rps"]
+
+
+def test_serve_bench_decode_quick(tmp_path):
+    """Decode-section smoke: continuous batching engages against the
+    matched-deployment sequential baseline, the recompile counter stays
+    zero through the timed window, and the BENCH_decode.json schema
+    holds. The >=3x@32-clients acceptance ratio is recorded by the full
+    bench (BENCH_decode.json), not asserted on noisy CI hosts."""
+    bench = _load_bench()
+    r = bench._bench_decode(quick=True)
+    assert r["sequential_tps"] > 0
+    c8 = r["clients_8"]
+    assert np.isfinite(c8["continuous_tps"]) and c8["continuous_tps"] > 0
+    assert c8["steady_state_recompiles"] == 0, \
+        "bucketed decode recompiled after warmup"
+    # executable universe: <= |prompt buckets| + |decode buckets|
+    assert c8["executable_bound"] >= 2
+    for side in ("ttft", "tpot"):
+        assert c8[side] is not None
+        for k in ("p50_ms", "p95_ms", "p99_ms", "window"):
+            assert k in c8[side], (side, k)
+    path = str(tmp_path / "BENCH_decode.json")
+    payload = dict(r)
+    payload["bench"] = "serve_decode"
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["bench"] == "serve_decode"
+    assert loaded["clients_8"]["steady_state_recompiles"] == 0

@@ -136,6 +136,213 @@ def _bench_one(build, n_req, clients, max_batch):
     }
 
 
+# ===================================================================
+# Generative decode: continuous batching vs sequential batch-1
+# ===================================================================
+
+_DECODE_GEO = dict(vocab_size=128, num_layers=2, d_model=32, n_heads=2,
+                   seq_len=64)
+
+
+def _build_decode_module(seed=11):
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import transformer
+    net = transformer.get_symbol(**_DECODE_GEO)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    s = _DECODE_GEO["seq_len"]
+    mod.bind(data_shapes=[("data", (1, s))],
+             label_shapes=[("softmax_label", (1, s))])
+    mx.random.seed(seed)
+    mod.init_params(mx.init.Uniform(0.05))
+    return mod
+
+
+def _decode_prompts(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(1, _DECODE_GEO["vocab_size"],
+                             size=rng.randint(2, 12)))
+            for _ in range(n)]
+
+
+def _decode_closed_loop(mod, clients, n_req, new_tokens, max_sequences):
+    """``clients`` closed-loop generators against one GenerativeServer;
+    returns (tok/s, ttft snapshot, tpot snapshot, steady recompiles,
+    executable bound). ``max_sequences=1`` with ``clients=1`` IS the
+    sequential batch-1 baseline — same engine, no co-residency."""
+    from mxnet_tpu import profiler, serve
+    name = "dbench%d_%d" % (clients, max_sequences)
+    srv = serve.GenerativeServer(mod, n_heads=_DECODE_GEO["n_heads"],
+                                 max_sequences=max_sequences, page=16,
+                                 int8=False, queue_bound=4 * clients + 8,
+                                 name=name)
+    prompts = _decode_prompts(64)
+    try:
+        # warmup wave: the LONGEST prompt in the pool decodes to the
+        # deepest position any timed request reaches, so every
+        # prompt/decode bucket is compiled before the timed window —
+        # one stray bucket compile (~400ms) would otherwise dominate a
+        # sub-second measurement
+        longest = max(prompts, key=len)
+        warm = [srv.submit_generate(longest, max_new_tokens=new_tokens)
+                for _ in range(min(clients, max_sequences) or 1)]
+        for h in warm:
+            h.result(timeout=300)
+        compiles_warm = profiler.get_counter(name + "_compile")
+        srv.latency.reset()
+        per_client = max(n_req // clients, 1)
+        tokens_out = [0] * clients
+        errors = []
+
+        def client(cid):
+            try:
+                for i in range(per_client):
+                    h = srv.submit_generate(
+                        prompts[(cid + i * clients) % len(prompts)],
+                        max_new_tokens=new_tokens)
+                    tokens_out[cid] += len(h.result(timeout=300))
+            except Exception as exc:               # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        st = srv.stats()
+        recompiles = profiler.get_counter(name + "_compile") - compiles_warm
+        return (sum(tokens_out) / dt, st["ttft"], st["tpot"], recompiles,
+                st["executable_bound"])
+    finally:
+        srv.close()
+
+
+_COLD_START_SCRIPT = r"""
+import os, sys, time, json
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, %(root)r)
+t_proc = time.perf_counter()
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+net = transformer.get_symbol(**%(geo)r)
+mod = mx.mod.Module(net, context=mx.cpu())
+s = %(geo)r["seq_len"]
+mod.bind(data_shapes=[("data", (1, s))],
+         label_shapes=[("softmax_label", (1, s))])
+mx.random.seed(11)
+mod.init_params(mx.init.Uniform(0.05))
+srv = mx.serve.GenerativeServer(mod, n_heads=%(geo)r["n_heads"],
+                                max_sequences=4, page=16, int8=False,
+                                name="coldbench")
+t0 = time.perf_counter()
+h = srv.submit_generate([3, 1, 4, 1, 5], max_new_tokens=4)
+first = next(iter(h))
+ttft = time.perf_counter() - t0
+h.result(timeout=300)
+srv.close()
+snap = mx.obs.report()
+backend = len([c for c in snap["compiles"] if c.get("scope") == "coldbench"])
+print(json.dumps({"ttft_s": ttft, "backend_compiles": backend,
+                  "proc_s": time.perf_counter() - t_proc}))
+"""
+
+
+def _cold_start_ttft(cache_dir=None):
+    """Fresh process -> first generated token, with/without the
+    executable cache. Returns the subprocess's own measurement."""
+    import subprocess
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "..")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if cache_dir is None:
+        env.pop("MXNET_TPU_COMPILE_CACHE", None)
+    else:
+        env["MXNET_TPU_COMPILE_CACHE"] = cache_dir
+    code = _COLD_START_SCRIPT % {"root": os.path.abspath(root),
+                                 "geo": _DECODE_GEO}
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        raise RuntimeError("cold-start probe failed:\n" + out.stderr)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _bench_decode(quick=False, reps=1):
+    """The ISSUE 16 acceptance table: aggregate tok/s continuous vs
+    sequential batch-1, TTFT/TPOT percentiles, zero steady-state
+    recompiles, cold-start-to-first-token with and without the
+    executable cache."""
+    mod = _build_decode_module()
+    new_tokens = 8 if quick else 16
+    client_loads = [8] if quick else [8, 32]
+    out = {"new_tokens_per_request": new_tokens,
+           "geometry": dict(_DECODE_GEO)}
+
+    # baseline: batch-1 SCHEDULING on the SAME deployment — one
+    # closed-loop client against the identical 32-slot server, so the
+    # cache geometry and executable set match and the comparison
+    # isolates the scheduling policy (the Orca/vLLM experimental
+    # control), not a smaller cache's cheaper step
+    seq_tps = 0.0
+    for _ in range(reps):
+        tps, _, _, _, _ = _decode_closed_loop(
+            mod, clients=1, n_req=4 if quick else 12,
+            new_tokens=new_tokens, max_sequences=32)
+        seq_tps = max(seq_tps, tps)
+    out["sequential_tps"] = round(seq_tps, 1)
+
+    for clients in client_loads:
+        best = None
+        for _ in range(reps):
+            tps, ttft, tpot, recompiles, bound = _decode_closed_loop(
+                mod, clients=clients,
+                n_req=2 * clients if quick else 3 * clients,
+                new_tokens=new_tokens, max_sequences=32)
+            if best is None or tps > best["tps"]:
+                best = {"tps": tps, "ttft": ttft, "tpot": tpot,
+                        "recompiles": recompiles, "bound": bound}
+        assert best["recompiles"] == 0, (
+            "steady-state decode recompiled %d times" % best["recompiles"])
+        out["clients_%d" % clients] = {
+            "continuous_tps": round(best["tps"], 1),
+            "speedup_vs_sequential": round(best["tps"] / seq_tps, 2),
+            "ttft": best["ttft"],
+            "tpot": best["tpot"],
+            "steady_state_recompiles": best["recompiles"],
+            "executable_bound": best["bound"],
+        }
+        print("decode c=%-3d seq %7.1f tok/s  continuous %8.1f tok/s  "
+              "%5.2fx  ttft p50 %s ms  tpot p50 %s ms  recompiles %d"
+              % (clients, seq_tps, best["tps"], best["tps"] / seq_tps,
+                 (best["ttft"] or {}).get("p50_ms"),
+                 (best["tpot"] or {}).get("p50_ms"),
+                 best["recompiles"]))
+
+    if not quick:
+        import tempfile
+        cold = _cold_start_ttft(cache_dir=None)
+        cache_dir = tempfile.mkdtemp(prefix="serve_bench_aot_")
+        _cold_start_ttft(cache_dir=cache_dir)       # populate
+        warm = _cold_start_ttft(cache_dir=cache_dir)
+        assert warm["backend_compiles"] == 0, (
+            "AOT warm restart still compiled %d serve programs"
+            % warm["backend_compiles"])
+        out["cold_start"] = {
+            "no_cache_ttft_s": round(cold["ttft_s"], 3),
+            "compile_cache_ttft_s": round(warm["ttft_s"], 3),
+            "compile_cache_backend_compiles": warm["backend_compiles"],
+        }
+        print("decode cold-start ttft: %.3fs uncached -> %.3fs with "
+              "MXNET_TPU_COMPILE_CACHE (0 backend compiles)"
+              % (cold["ttft_s"], warm["ttft_s"]))
+    return out
+
+
 def run(quick=False, reps=1):
     n_req = 400 if quick else 4000
     clients = 16 if quick else 32
@@ -164,6 +371,7 @@ def run(quick=False, reps=1):
               "p50 %s ms  p99 %s ms  occ %s"
               % (name, r["sequential_rps"], r["served_rps"], r["speedup"],
                  r["p50_ms"], r["p99_ms"], r["occupancy"]))
+    results["decode"] = _bench_decode(quick=quick, reps=reps)
     return results
 
 
@@ -174,13 +382,29 @@ def main():
     ap.add_argument("--reps", type=int, default=1,
                     help="repetitions; best throughput per side is kept")
     ap.add_argument("--json", default=None, help="write results to PATH")
+    ap.add_argument("--decode-only", action="store_true",
+                    help="run only the generative-decode section")
+    ap.add_argument("--decode-json", default=None,
+                    help="write the decode section to PATH "
+                         "(BENCH_decode.json)")
     args = ap.parse_args()
-    results = run(quick=args.quick, reps=args.reps)
+    if args.decode_only:
+        results = {"decode": _bench_decode(quick=args.quick,
+                                           reps=args.reps)}
+    else:
+        results = run(quick=args.quick, reps=args.reps)
     if args.json:
         with open(args.json, "w") as f:
             json.dump({"bench": "serving", "results": results}, f,
                       indent=2)
         print("wrote", args.json)
+    if args.decode_json:
+        payload = dict(results["decode"])
+        payload["bench"] = "serve_decode"
+        payload["reps"] = args.reps
+        with open(args.decode_json, "w") as f:
+            json.dump(payload, f, indent=2)
+        print("wrote", args.decode_json)
     return results
 
 
