@@ -70,10 +70,12 @@ def __getattr__(name):
     # unless used — the MXNET_TPU_ANALYZE=off bind path is asserted to be
     # import-free (tests/test_analysis.py::test_analyze_off_is_zero_cost).
     # elastic/faults ride the same hook (the supervisor is subprocess
-    # tooling, not a training-path dependency).
+    # tooling, not a training-path dependency). data too: a fit fed by
+    # any other iterator must never import the streaming loader or its
+    # multiprocessing machinery (tools/data_smoke.py zero-cost gate).
     # importlib, NOT `from . import analysis`: the fromlist form re-enters
     # this __getattr__ via importlib._handle_fromlist -> infinite recursion
-    if name in ("analysis", "checkpoint", "elastic", "faults"):
+    if name in ("analysis", "checkpoint", "data", "elastic", "faults"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

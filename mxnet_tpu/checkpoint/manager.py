@@ -259,6 +259,16 @@ class Checkpoint(object):
     def metric_state(self):
         return self.meta.get("metric")
 
+    @property
+    def data_cursor(self) -> Optional[dict]:
+        """The data-plane loader cursor saved with this checkpoint
+        (``meta["loop"]["data"]``) — position plus the stream-identity
+        fields (seed, batch size, record count) a resuming
+        ``mx.data.DataLoader`` validates before fast-forwarding. None
+        for checkpoints written without a cursor-capable iterator."""
+        cur = self.loop.get("data")
+        return dict(cur) if cur else None
+
     # -------------------------------------------------------- parameters
     def _named(self, prefix: str, names_key: str) -> Dict[str, np.ndarray]:
         names = self.meta.get(names_key)
@@ -377,7 +387,8 @@ class CheckpointManager(object):
 
     def preempt_save(self, module, epoch: Optional[int] = None,
                      batches_done: Optional[int] = None,
-                     metric=None) -> None:
+                     metric=None, loader_state: Optional[dict] = None
+                     ) -> None:
         """The preemption-notice path (``fit`` calls this when it observes
         :attr:`preempt_requested`): drain pending async saves, land the
         final checkpoint synchronously, and shut the writer down. Runs on
@@ -395,7 +406,8 @@ class CheckpointManager(object):
         try:
             self.save_module(module, epoch=epoch,
                              batches_done=batches_done,
-                             metric=metric, sync=True)
+                             metric=metric, loader_state=loader_state,
+                             sync=True)
         except _format.CheckpointPodError as exc:
             # a pod being drained because a PEER died cannot land a
             # collective final save (the commit barrier has a dead
@@ -419,6 +431,7 @@ class CheckpointManager(object):
     # ------------------------------------------------------------ saving
     def save_module(self, module, epoch: Optional[int] = None,
                     batches_done: Optional[int] = None, metric=None,
+                    loader_state: Optional[dict] = None,
                     sync: Optional[bool] = None) -> int:
         """Snapshot ``module`` (+ loop position + metric accumulators)
         and schedule the write; returns the checkpoint step. The caller
@@ -436,6 +449,11 @@ class CheckpointManager(object):
         with _profiler.span("ckpt_snapshot", "ckpt"):
             tensors, meta = snap()
         meta["loop"] = {"epoch": epoch, "batches_done": batches_done}
+        if loader_state is not None:
+            # the data-plane cursor (mx.data.DataLoader._mx_cursor):
+            # position + the stream-identity fields a resume validates
+            # (docs/architecture/data_plane.md cursor format)
+            meta["loop"]["data"] = dict(loader_state)
         if metric is not None:
             state_fn = getattr(metric, "_ckpt_state", None)
             meta["metric"] = state_fn() if state_fn is not None else None

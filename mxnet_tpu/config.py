@@ -179,6 +179,18 @@ register("MXNET_TPU_DEVICE_PREFETCH", int, 2,
          "fit(): batches device-placed ahead of the step consuming them "
          "(PrefetchingIter device stage, double-buffered H2D overlap); "
          "0 = place each batch synchronously on the critical path")
+register("MXNET_TPU_DATA_WORKERS", int, 2,
+         "mx.data.DataLoader: default worker PROCESSES decoding disjoint "
+         "shard ranges in parallel (overridden by the num_workers "
+         "argument); 0 = decode inline in the consumer thread")
+register("MXNET_TPU_DATA_QUEUE_DEPTH", int, 4,
+         "mx.data.DataLoader: decoded batches buffered per worker "
+         "process (the backpressure bound — a stalled consumer parks "
+         "the workers instead of buffering the epoch in RAM)")
+register("MXNET_TPU_DATA_MP", _parse_bool, True,
+         "mx.data.DataLoader: multi-process decode kill switch — 0 "
+         "forces the inline single-thread path regardless of "
+         "num_workers (same stream order, the bisection fallback)")
 register("MXNET_TPU_DEVICE_METRICS", _parse_bool, True,
          "EvalMetric.update_device: accumulate (sum, count) as device "
          "reductions chained after the step, host sync deferred to "

@@ -1,0 +1,23 @@
+"""``mx.data`` — the sharded multi-worker streaming data plane.
+
+A multi-process loader that feeds a POD, not a chip: worker processes
+own disjoint RecordIO shard ranges partitioned deterministically from
+``(seed, epoch, world_size, num_workers)``, decode/augment in parallel,
+and hand batches to ``fit``'s device-prefetch stage (per-host
+``device_put`` onto the mesh's ``data`` axis) in a delivery order that
+is a pure function of ``(seed, epoch, world)`` — so checkpoints resume
+the stream bit-exactly even after an elastic worker-count or pod-world
+change.
+
+Import discipline: this package is LAZY (``mx.data`` resolves through
+the top-level ``__getattr__``) and nothing in the training path imports
+it — a fit over any other iterator never loads it and never moves a
+``data_*`` counter (the zero-cost gate in tools/data_smoke.py asserts
+both). Design: docs/architecture/data_plane.md.
+"""
+from .partition import PartitionPlan, epoch_order
+from .loader import DataLoader
+from .transforms import ImageTransform, RawTransform, StallTransform
+
+__all__ = ["DataLoader", "PartitionPlan", "epoch_order", "RawTransform",
+           "ImageTransform", "StallTransform"]

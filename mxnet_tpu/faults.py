@@ -46,6 +46,16 @@ serve.decode         GenerativeServer, before      raise
 serve.evict          GenerativeServer, during      raise
                      sequence eviction (pages are
                      still freed — no leak)
+data.worker          data-plane worker process,    sigkill
+                     each batch start (the loader
+                     respawns it over its
+                     undelivered shard range and
+                     replays exactly; respawned
+                     generations do not re-fire)
+data.decode          data-plane decode of one      raise
+                     batch (poisons THAT batch —
+                     data_batch_poisoned — never
+                     the epoch)
 ===================  ============================  =====================
 
 Failure kinds: ``eio``/``enospc``/``eintr`` raise the matching
@@ -100,6 +110,16 @@ SITES = frozenset((
     "ckpt.after_manifest", "ckpt.before_rename", "ckpt.read_manifest",
     "ckpt.read_arrays", "fit.batch", "serve.submit", "serve.decode",
     "serve.evict", "host.die", "leader.die", "dist.kv",
+    # data plane (mxnet_tpu.data, docs/architecture/data_plane.md):
+    #   data.worker — fires at a worker process's batch start, default
+    #                 sigkill: the loader must detect the corpse,
+    #                 respawn generation 1 over the undelivered shard
+    #                 range and replay it exactly (respawned workers do
+    #                 NOT re-fire this site — progress, not a kill loop)
+    #   data.decode — fires in the decode of one batch, default raise:
+    #                 poisons THAT batch only (data_batch_poisoned),
+    #                 the epoch continues
+    "data.worker", "data.decode",
 ))
 
 # kinds that model a HOST dying rather than one process failing

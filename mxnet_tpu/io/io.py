@@ -285,6 +285,12 @@ class PrefetchingIter(DataIter):
       (``MXNET_TPU_DEVICE_PREFETCH``).
     """
 
+    # fit's straggler telemetry duck-types this: the consumer-side fetch
+    # is a queue pop fed by a background thread, so time spent in it is
+    # a data-plane wait (counted as loop_prefetch_stall), not rank-local
+    # compute — the inter-step window excludes it (base_module.fit)
+    _mx_offthread_fetch = True
+
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth: int = 2, device_placer=None,
                  device_prefetch: Optional[int] = None):

@@ -424,6 +424,18 @@ class BaseModule(object):
         inflight = _fused_mod.InflightWindow(window)
         step_token = getattr(self, "_step_token", lambda: None)
 
+        def _loader_hook(it, name):
+            # a data-plane hook (_mx_cursor / _mx_fast_forward) on the
+            # iterator, looking through one user-applied prefetch
+            # wrapper (fit's own wrap happens AFTER these resolve, so
+            # only a pre-wrapped PrefetchingIter needs unwrapping)
+            fn = getattr(it, name, None)
+            if fn is None:
+                inner = getattr(it, "iters", None)
+                if inner:
+                    fn = getattr(inner[0], name, None)
+            return fn
+
         resume_skip_eoe = False
         if resume is not None and resume.mid_epoch:
             # fast-forward the INNER iterator past the batches the
@@ -431,13 +443,31 @@ class BaseModule(object):
             # wrapper spins up its worker (no compute — the restored
             # params/opt state already reflect those batches, and skipped
             # batches must not be device-placed just to be discarded)
-            skip_iter = iter(train_data)
-            for _ in range(resume.batches_done):
-                try:
-                    next(skip_iter)
-                except StopIteration:
-                    resume_skip_eoe = True
-                    break
+            ff = _loader_hook(train_data, "_mx_fast_forward")
+            if ff is not None:
+                # a cursor-capable loader (mx.data.DataLoader) seeks
+                # straight to the batch index — no decode of skipped
+                # batches — after validating the saved cursor's stream
+                # identity (seed/batch size/record count) against this
+                # run's configuration
+                ff(begin_epoch, resume.batches_done,
+                   cursor=resume.data_cursor)
+            else:
+                skip_iter = iter(train_data)
+                for _ in range(resume.batches_done):
+                    try:
+                        next(skip_iter)
+                    except StopIteration:
+                        resume_skip_eoe = True
+                        break
+        elif resume is not None:
+            # epoch-boundary resume: sync a cursor-capable loader's
+            # shuffle epoch (and validate stream identity) so epoch
+            # begin_epoch's permutation matches what an uninterrupted
+            # run would have drawn
+            ff = _loader_hook(train_data, "_mx_fast_forward")
+            if ff is not None:
+                ff(begin_epoch, 0, cursor=resume.data_cursor)
 
         wrapped = None
         inner_train_data = train_data
@@ -456,6 +486,12 @@ class BaseModule(object):
                 # stacking a second PrefetchingIter would add a worker
                 # thread and a queue hop just for the placement stage —
                 # those batches are placed in _load_batch instead
+
+        # the data-plane cursor source for checkpoint manifests; called
+        # with fit's CONSUMED count (nbatch) — the loader's own
+        # delivered count runs prefetch-depth ahead of consumption and
+        # would fast-forward a resume past unseen batches
+        cursor_fn = _loader_hook(inner_train_data, "_mx_cursor")
 
         # the training thread's trace lane: step/checkpoint-snapshot spans
         # land here; metric syncs get their own track (docs/architecture/
@@ -599,6 +635,18 @@ class BaseModule(object):
                         self.prepare(next_data_batch)
                     except StopIteration:
                         end_of_batch = True
+                    if straggler is not None and getattr(
+                            train_data, "_mx_offthread_fetch", False):
+                        # re-derived for the streaming data plane: an
+                        # OFF-THREAD fetch (PrefetchingIter queue pop,
+                        # DataLoader worker-queue pop) is a data-plane
+                        # wait — already surfaced as loop_prefetch_stall
+                        # / data_stall — not rank-local compute; leaving
+                        # it in the window would flag a slow LOADER as a
+                        # straggling HOST. An inline iterator's decode
+                        # happens on this thread and stays counted as
+                        # local work (the PR 13 window semantics).
+                        t_host_mark = time.perf_counter()
                     if monitor is not None:
                         monitor.toc_print()
                     if batch_end_callback is not None:
@@ -618,17 +666,23 @@ class BaseModule(object):
                             # cheap phase) and resume the loop while the
                             # writer drains to disk behind it
                             inflight.drain()
-                            ckpt_mgr.save_module(self, epoch=epoch,
-                                                 batches_done=nbatch,
-                                                 metric=eval_metric)
+                            ckpt_mgr.save_module(
+                                self, epoch=epoch, batches_done=nbatch,
+                                metric=eval_metric,
+                                loader_state=cursor_fn(
+                                    epoch=epoch, batches_done=nbatch)
+                                if cursor_fn else None)
                         if ckpt_mgr.preempt_requested:
                             # SIGTERM (preemption notice): finish this
                             # batch, land a SYNCHRONOUS save, and exit
                             # with the conventional 128+15 status
                             inflight.drain()
-                            ckpt_mgr.preempt_save(self, epoch=epoch,
-                                                  batches_done=nbatch,
-                                                  metric=eval_metric)
+                            ckpt_mgr.preempt_save(
+                                self, epoch=epoch, batches_done=nbatch,
+                                metric=eval_metric,
+                                loader_state=cursor_fn(
+                                    epoch=epoch, batches_done=nbatch)
+                                if cursor_fn else None)
                             self.logger.warning(
                                 "SIGTERM: checkpoint saved at epoch %d "
                                 "batch %d; exiting with status 143",
@@ -707,12 +761,19 @@ class BaseModule(object):
                                          epoch, name, val)
 
                 if ckpt_mgr is not None:
+                    # epoch-boundary cursor: the NEXT epoch at batch 0,
+                    # which is where a resume from this checkpoint starts
+                    _eoe_cursor = cursor_fn(epoch=epoch + 1,
+                                            batches_done=0) \
+                        if cursor_fn else None
                     if (epoch + 1) % ckpt_period == 0:
                         ckpt_mgr.save_module(self, epoch=epoch,
-                                             metric=eval_metric)
+                                             metric=eval_metric,
+                                             loader_state=_eoe_cursor)
                     if ckpt_mgr.preempt_requested:
                         ckpt_mgr.preempt_save(self, epoch=epoch,
-                                              metric=eval_metric)
+                                              metric=eval_metric,
+                                              loader_state=_eoe_cursor)
                         self.logger.warning(
                             "SIGTERM: checkpoint saved at end of epoch "
                             "%d; exiting with status 143", epoch)

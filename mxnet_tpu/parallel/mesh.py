@@ -24,7 +24,8 @@ from ..context import Context
 __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "replicated_sharding", "shard_batch", "replicate", "P", "Mesh",
            "NamedSharding", "mesh_devices", "sharding_island",
-           "axis_sizes", "validate_spec", "resolve_layout_spec"]
+           "axis_sizes", "validate_spec", "resolve_layout_spec",
+           "host_partition"]
 
 # a layout maps array name -> PartitionSpec: a dict (exact name match
 # wins, then regex fullmatch), a callable name -> spec, a SpecLayout
@@ -181,3 +182,52 @@ def shard_batch(mesh: Mesh, value, axis: str = "data", batch_dim: int = 0):
 def replicate(mesh: Mesh, value):
     """Place an array fully replicated over the mesh."""
     return jax.device_put(value, replicated_sharding(mesh))
+
+
+def host_partition(mesh: Optional[Mesh] = None) -> Tuple[int, int]:
+    """``(host_rank, host_world)`` for data-plane shard ownership — who
+    feeds which slice of the global batch stream (``mx.data.DataLoader
+    (part="auto")``).
+
+    Resolution order:
+
+    1. an explicit ``mesh``: its devices' PROCESS set — each host loads
+       only the stream slice its addressable devices consume when the
+       batch is ``device_put`` onto the ``data`` axis (a single-process
+       mesh, however many devices, is one host: device count never
+       enters the partition);
+    2. the active ``jax.distributed`` pod (state probe only — never
+       initializes anything, mirroring ``checkpoint.format.pod_info``);
+    3. the DMLC launcher env (``DMLC_WORKER_ID``/``DMLC_NUM_WORKER`` —
+       coordinated pods whose children predate jax.distributed init);
+    4. ``(0, 1)`` — single host.
+    """
+    if mesh is not None:
+        try:
+            procs = sorted({d.process_index
+                            for d in np.asarray(mesh.devices).flat})
+            if len(procs) > 1:
+                me = jax.process_index()
+                return (procs.index(me) if me in procs else 0,
+                        len(procs))
+        except Exception:                              # noqa: BLE001
+            pass
+    import sys
+    if "jax" in sys.modules:
+        try:
+            from jax._src import distributed as _jdist
+            state = _jdist.global_state
+            if getattr(state, "client", None) is not None:
+                return (int(state.process_id or 0),
+                        int(state.num_processes or 1))
+        except Exception:                              # noqa: BLE001
+            pass
+    import os
+    try:
+        world = int(os.environ.get("DMLC_NUM_WORKER", "1") or 1)
+        rank = int(os.environ.get("DMLC_WORKER_ID", "0") or 0)
+    except ValueError:
+        return 0, 1
+    if world > 1:
+        return min(rank, world - 1), world
+    return 0, 1

@@ -103,6 +103,7 @@ class MXRecordIO(object):
         assert not self.writable
         parts = []
         while True:
+            offset = self.handle.tell()
             header = self.handle.read(8)
             if len(header) < 8:
                 if parts:  # EOF mid-chain: a truncated multi-part record
@@ -111,10 +112,21 @@ class MXRecordIO(object):
                 return None
             magic, lrec = struct.unpack("<II", header)
             if magic != _kMagic:
-                raise IOError("Invalid magic number in record file %s" % self.uri)
+                raise IOError(
+                    "Invalid magic number 0x%08x at offset %d of record "
+                    "file %s (expected 0x%08x — a corrupt file, or a "
+                    "seek to a non-record boundary)"
+                    % (magic, offset, self.uri, _kMagic))
             cflag = lrec >> 29
             length = lrec & ((1 << 29) - 1)
             data = self.handle.read(length)
+            if len(data) < length:
+                # a short payload read used to flow downstream and die
+                # as an opaque struct.unpack error — name the truncation
+                raise IOError(
+                    "truncated record at offset %d of %s: header "
+                    "promises %d payload bytes, file ends after %d"
+                    % (offset, self.uri, length, len(data)))
             pad = (-length) % 4
             if pad:
                 self.handle.read(pad)
@@ -141,7 +153,17 @@ class MXRecordIO(object):
 
 class MXIndexedRecordIO(MXRecordIO):
     """Random-access record file keyed by an index sidecar (reference:
-    recordio.py MXIndexedRecordIO; idx file = "key\\toffset" lines)."""
+    recordio.py MXIndexedRecordIO; idx file = "key\\toffset" lines).
+
+    Index entries are VALIDATED against the record file at load: an
+    offset past (or too near) EOF cannot hold a record header, so it is
+    rejected here with the index key named — instead of surfacing later
+    as an opaque ``struct.unpack``/magic error from whatever
+    ``read_idx`` call happens to hit it first. ``read_idx`` wraps the
+    remaining in-file corruption shapes (bad magic at a valid offset,
+    truncated payload) the same way: every error names the index key
+    and the files involved (tamper tests: tests/test_io.py).
+    """
 
     def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
         self.idx_path = idx_path
@@ -150,11 +172,32 @@ class MXIndexedRecordIO(MXRecordIO):
         self.key_type = key_type
         super().__init__(uri, flag)
         if not self.writable and os.path.isfile(idx_path):
+            # a record needs at least its 8-byte header before EOF; an
+            # offset beyond that bound indexes nothing
+            self.handle.seek(0, 2)
+            fsize = self.handle.tell()
+            self.handle.seek(0)
             with open(idx_path) as fin:
-                for line in fin:
-                    line = line.strip().split("\t")
-                    key = key_type(line[0])
-                    self.idx[key] = int(line[1])
+                for lineno, raw in enumerate(fin, 1):
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    fields = raw.split("\t")
+                    try:
+                        key = key_type(fields[0])
+                        offset = int(fields[1])
+                    except (IndexError, ValueError) as exc:
+                        raise IOError(
+                            "malformed index entry at %s:%d (%r): %s"
+                            % (idx_path, lineno, raw, exc))
+                    if offset < 0 or offset + 8 > fsize:
+                        raise IOError(
+                            "index key %r at %s:%d points at offset %d "
+                            "but %s holds only %d bytes — the index does "
+                            "not match this record file (stale or "
+                            "corrupt .idx)"
+                            % (key, idx_path, lineno, offset, uri, fsize))
+                    self.idx[key] = offset
                     self.keys.append(key)
 
     def close(self):
@@ -167,11 +210,26 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         """(reference: recordio.py seek)."""
         assert not self.writable
+        if idx not in self.idx:
+            raise KeyError(
+                "key %r not in index %s (%d keys)"
+                % (idx, self.idx_path, len(self.idx)))
         self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx) -> bytes:
         self.seek(idx)
-        return self.read()
+        try:
+            buf = self.read()
+        except (IOError, OSError, struct.error) as exc:
+            raise IOError(
+                "reading index key %r (offset %d) of %s failed: %s"
+                % (idx, self.idx[idx], self.uri, exc))
+        if buf is None:
+            raise IOError(
+                "index key %r points at offset %d of %s, which is EOF — "
+                "the index does not match this record file"
+                % (idx, self.idx[idx], self.uri))
+        return buf
 
     def write_idx(self, idx, buf: bytes):
         """(reference: recordio.py write_idx)."""

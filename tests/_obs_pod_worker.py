@@ -2,7 +2,7 @@
 pod-suffixed profiler dumps). Run by tests/test_obs_pod.py in a 2-rank
 DMLC fake cluster; NOT collected by pytest.
 
-argv: <mode> <outdir>   mode in {"slow", "balanced"}
+argv: <mode> <outdir>   mode in {"slow", "balanced", "slowloader"}
 
 Both ranks train the same tiny regression over the dist kvstore with a
 ``fit.batch:slow`` fault armed on EVERY batch — ``balanced`` gives both
@@ -10,6 +10,13 @@ ranks the same per-batch sleep (work rates equal, detection must stay
 silent), ``slow`` gives rank 1 a much larger one (rank 0's aggregation
 must flag it). Using the fault's sleep as the work floor makes the
 ratio deterministic instead of riding microsecond-scale fwd/bwd noise.
+
+``slowloader`` (ISSUE 17 satellite) keeps the compute balanced but
+feeds rank 0 through a ``mx.data.DataLoader`` whose transform stalls
+far longer per batch than the work floor: a slow DATA PLANE. The
+inter-step window re-mark in fit (base_module) must keep that stall
+out of the straggler rate — detection stays silent and the slowness
+surfaces as ``data_stall``/``loop_prefetch_stall`` instead.
 """
 import json
 import os
@@ -36,6 +43,7 @@ def main():
 
     rank = int(os.environ["DMLC_WORKER_ID"])
     sleep = {"balanced": ("0.05", "0.05"),
+             "slowloader": ("0.05", "0.05"),
              "slow": ("0.05", "0.30")}[mode][min(rank, 1)]
     os.environ["MXNET_TPU_FAULTS_SLOW_SECS"] = sleep
     faults.install("fit.batch:slow")
@@ -46,7 +54,29 @@ def main():
     rng = np.random.RandomState(11)
     X = rng.uniform(-1, 1, (NSAMP, FEAT)).astype(np.float32)
     Y = rng.uniform(-1, 1, (NSAMP, OUT)).astype(np.float32)
-    it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch_size=BATCH)
+    if mode == "slowloader":
+        # rank 0 streams through the data plane with a per-record stall
+        # that dwarfs the 0.05s work floor (~0.4s/batch of loader
+        # latency): without the off-thread fetch re-mark in fit, rank
+        # 0's work rate would read ~8x slow and trip the ratio=3 flag
+        from mxnet_tpu import recordio
+        rec = os.path.join(outdir, "d-r%d.rec" % rank)
+        idx = os.path.join(outdir, "d-r%d.idx" % rank)
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i in range(NSAMP):
+            w.write_idx(i, recordio.pack(
+                recordio.IRHeader(OUT, Y[i], i, 0), X[i].tobytes()))
+        w.close()
+        transform = mx.data.RawTransform((FEAT,), label_width=OUT)
+        if rank == 0:
+            transform = mx.data.StallTransform(transform, 0.05)
+        it = mx.data.DataLoader(
+            rec, idx_path=idx, batch_size=BATCH, transform=transform,
+            shuffle=False, num_workers=1, part=(0, 1),
+            label_name="label")
+    else:
+        it = mx.io.NDArrayIter({"data": X}, {"label": Y},
+                               batch_size=BATCH)
     data = mx.sym.Variable("data")
     fc = mx.sym.FullyConnected(data, num_hidden=OUT, name="fc")
     net = mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("label"))
@@ -69,6 +99,9 @@ def main():
         "obs_straggler": profiler.get_counter("obs_straggler"),
         "publish_failed": profiler.get_counter(
             "obs_straggler_publish_failed"),
+        "data_stall": profiler.get_counter("data_stall"),
+        "loop_prefetch_stall": profiler.get_counter(
+            "loop_prefetch_stall"),
         "gauges": {k: v for k, v in profiler.gauges().items()
                    if k.startswith("obs_pod_")},
     }

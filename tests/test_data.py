@@ -1,0 +1,468 @@
+"""mx.data — the sharded multi-worker streaming data plane.
+
+What must hold (docs/architecture/data_plane.md):
+
+* **order is a pure function** of (seed, epoch, world, rank, batch
+  size) — NEVER of worker count: identical streams across
+  num_workers in {0, 1, 2, 4}, and across epochs at a fixed seed.
+* **exact cursor resume** — a mid-epoch checkpoint cursor fast-forwards
+  the stream bit-identically, including with a DIFFERENT worker count
+  (the elastic reshard path); mismatched stream identity fails loudly.
+* **fault containment** — a dead worker (``data.worker``) is respawned
+  over exactly its undelivered range (the stream stays identical); a
+  decode fault (``data.decode``) poisons ONE batch, never the epoch.
+* **zero cost when unused** — a fit fed by any other iterator never
+  imports ``mxnet_tpu.data`` (subprocess-proven).
+* **straggler telemetry stays honest** — an off-thread loader stall is
+  a data-plane wait (``data_stall``/``loop_prefetch_stall``), excluded
+  from the PR 13 inter-step local-work window (regression for the
+  re-derivation in base_module.fit).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, profiler, recordio
+from mxnet_tpu import config as cfg
+from mxnet_tpu.checkpoint import CheckpointConfig, restore_latest
+from mxnet_tpu.data import (DataLoader, PartitionPlan, RawTransform,
+                            StallTransform, epoch_order)
+
+BATCH = 4
+FEAT = 6
+NCLS = 3
+NREC = 48
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ helpers
+
+@pytest.fixture()
+def dataset(tmp_path):
+    """An indexed RecordIO file whose record i carries data full of
+    distinctive values and label i % NCLS."""
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(NREC):
+        hdr = recordio.IRHeader(0, float(i % NCLS), i, 0)
+        payload = np.concatenate(
+            [[np.float32(i)], rng.uniform(-1, 1, FEAT - 1)]
+        ).astype(np.float32)
+        w.write_idx(i, recordio.pack(hdr, payload.tobytes()))
+    w.close()
+    return rec, idx
+
+
+def _loader(dataset, **kw):
+    rec, idx = dataset
+    kw.setdefault("batch_size", BATCH)
+    kw.setdefault("transform", RawTransform((FEAT,)))
+    kw.setdefault("shuffle", True)
+    kw.setdefault("seed", 11)
+    kw.setdefault("part", (0, 1))
+    return DataLoader(rec, idx_path=idx, **kw)
+
+
+def _stream(dl, close=True):
+    """Record ids of every delivered batch (data[:, 0] is the id)."""
+    out = [b.data[0][:, 0].astype(int).tolist() for b in dl]
+    if close:
+        dl.close()
+    return out
+
+
+# ------------------------------------------------------------ the partition
+
+def test_partition_is_pure_and_workers_cover_disjointly():
+    plan = PartitionPlan(100, 8, seed=5, epoch=2, num_workers=3)
+    again = PartitionPlan(100, 8, seed=5, epoch=2, num_workers=3)
+    assert list(plan.local_order) == list(again.local_order)
+    owned = [plan.owned_batches(w) for w in range(3)]
+    flat = sorted(b for lst in owned for b in lst)
+    assert flat == list(range(plan.num_batches))      # disjoint cover
+    for w, lst in enumerate(owned):
+        assert all(k % 3 == w for k in lst)           # k % W ownership
+    # a different epoch draws a different permutation...
+    other = PartitionPlan(100, 8, seed=5, epoch=3, num_workers=3)
+    assert list(other.local_order) != list(plan.local_order)
+    # ...and shuffle=False is file order
+    ident = PartitionPlan(100, 8, seed=5, epoch=2, shuffle=False)
+    assert list(ident.local_order) == list(range(100))
+    assert list(epoch_order(10, 0, 0, shuffle=False)) == list(range(10))
+
+
+def test_partition_world_strides_are_disjoint():
+    order = epoch_order(NREC, 11, 0, shuffle=True)
+    plans = [PartitionPlan(NREC, BATCH, seed=11, epoch=0, rank=r,
+                           world_size=2) for r in range(2)]
+    seen = [i for p in plans for i in p.local_order]
+    assert sorted(seen) == list(range(NREC))
+    # each host's sequence is the global permutation strided by rank
+    for r, p in enumerate(plans):
+        assert list(p.local_order) == list(order[r::2])
+
+
+# ----------------------------------------------------------- stream identity
+
+def test_stream_identical_across_worker_counts(dataset):
+    streams = {w: _stream(_loader(dataset, num_workers=w))
+               for w in (0, 1, 2, 4)}
+    for w in (1, 2, 4):
+        assert streams[w] == streams[0], "num_workers=%d diverged" % w
+    # shuffled: not file order
+    assert streams[0] != [list(range(i, i + BATCH))
+                          for i in range(0, NREC, BATCH)]
+
+
+def test_epochs_are_deterministic_and_distinct(dataset):
+    def epochs(workers):
+        dl = _loader(dataset, num_workers=workers)
+        e0 = _stream(dl, close=False)
+        dl.reset()
+        e1 = _stream(dl)
+        return e0, e1
+
+    a0, a1 = epochs(2)
+    b0, b1 = epochs(0)
+    assert (a0, a1) == (b0, b1)       # replayable across worker counts
+    assert a0 != a1                   # fresh permutation per epoch
+    flat0 = sorted(i for b in a0 for i in b)
+    assert flat0 == list(range(NREC))  # every record exactly once
+
+
+def test_world_partition_feeds_disjoint_hosts(dataset):
+    per_host = [_stream(_loader(dataset, num_workers=2, part=(r, 2)))
+                for r in range(2)]
+    flat = sorted(i for s in per_host for b in s for i in b)
+    assert flat == list(range(NREC))
+    assert not (set(i for b in per_host[0] for i in b)
+                & set(i for b in per_host[1] for i in b))
+
+
+def test_too_few_records_fails_loudly(dataset):
+    rec, idx = dataset
+    with pytest.raises(mx.MXNetError, match="cannot fill"):
+        DataLoader(rec, idx_path=idx, batch_size=NREC // 2,
+                   transform=RawTransform((FEAT,)), part=(0, 4))
+
+
+def test_transform_is_required(dataset):
+    rec, idx = dataset
+    with pytest.raises(ValueError, match="transform"):
+        DataLoader(rec, idx_path=idx, batch_size=BATCH)
+
+
+# --------------------------------------------------------------- the cursor
+
+def test_fast_forward_matches_uninterrupted_across_worker_counts(dataset):
+    base = _stream(_loader(dataset, num_workers=2))
+    for workers in (0, 1, 4):
+        dl = _loader(dataset, num_workers=workers)
+        cur = dl._mx_cursor(epoch=0, batches_done=5)
+        dl._mx_fast_forward(0, 5, cursor=cur)
+        assert _stream(dl) == base[5:], \
+            "resume at batch 5 with %d workers diverged" % workers
+
+
+def test_cursor_mismatch_names_the_field(dataset):
+    dl = _loader(dataset, num_workers=0, seed=11)
+    cur = dl._mx_cursor(epoch=0, batches_done=3)
+    dl.close()
+    other = _loader(dataset, num_workers=0, seed=99)
+    with pytest.raises(mx.MXNetError, match="seed"):
+        other._mx_fast_forward(0, 3, cursor=cur)
+    other.close()
+    smaller = _loader(dataset, num_workers=0, batch_size=BATCH * 2)
+    with pytest.raises(mx.MXNetError, match="batch_size"):
+        smaller._mx_fast_forward(0, 3, cursor=cur)
+    smaller.close()
+    future = dict(cur, version=cur["version"] + 1)
+    last = _loader(dataset, num_workers=0)
+    with pytest.raises(mx.MXNetError, match="version"):
+        last._mx_fast_forward(0, 3, cursor=future)
+    last.close()
+
+
+# ------------------------------------------------------------------- faults
+
+def test_worker_death_replays_exactly(dataset):
+    base = _stream(_loader(dataset, num_workers=2))
+    before = profiler.get_counter("data_worker_respawn")
+    faults.install("data.worker@1:sigkill")
+    try:
+        survived = _stream(_loader(dataset, num_workers=2))
+    finally:
+        faults.clear()
+    assert survived == base
+    assert profiler.get_counter("data_worker_respawn") > before
+
+
+def test_decode_fault_poisons_one_batch_not_the_epoch(dataset):
+    base = _stream(_loader(dataset, num_workers=1))
+    before = profiler.get_counter("data_batch_poisoned")
+    faults.install("data.decode@3:raise")
+    try:
+        poisoned = _stream(_loader(dataset, num_workers=1))
+    finally:
+        faults.clear()
+    assert len(poisoned) == len(base) - 1
+    assert profiler.get_counter("data_batch_poisoned") == before + 1
+    # the surviving batches are the base stream minus exactly one batch
+    it = iter(base)
+    dropped = 0
+    for b in poisoned:
+        while next(it) != b:
+            dropped += 1
+    assert dropped <= 1
+
+
+def test_decode_fault_inline_path(dataset):
+    base = _stream(_loader(dataset, num_workers=0))
+    faults.install("data.decode@2:raise")
+    try:
+        poisoned = _stream(_loader(dataset, num_workers=0))
+    finally:
+        faults.clear()
+    assert len(poisoned) == len(base) - 1
+
+
+def test_steady_state_has_zero_stalls(dataset):
+    """A decode pool that keeps up must never stall the consumer — the
+    counter-assert the ISSUE pins for the steady state (the bench and
+    tools/data_smoke.py assert the same through a real fit)."""
+    import time
+    before = profiler.get_counter("data_stall")
+    dl = _loader(dataset, num_workers=2, queue_depth=8)
+    batches = 0
+    for _ in dl:
+        batches += 1
+        time.sleep(0.01)             # the "step": consume slower than
+        # decode so the queues stay warm — zero bubbles expected
+    dl.close()
+    assert batches == NREC // BATCH
+    assert profiler.get_counter("data_stall") == before
+
+
+# ----------------------------------------------------------- fit integration
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=NCLS, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _seed_init():
+    rng = np.random.RandomState(42)
+    shapes = {"data": (BATCH, FEAT), "softmax_label": (BATCH,)}
+    sym = _mlp()
+    args, _, _ = sym.infer_shape(**shapes)
+    return {n: mx.nd.array(rng.uniform(-0.1, 0.1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), args) if n not in shapes}
+
+
+class _Stop(Exception):
+    """In-process crash: abandons fit() from a batch-end callback."""
+
+
+def _fit(dataset, epochs, workers, ckpt=None, resume=None, seed=True,
+         stop_after=None, stall_s=0.0):
+    mx.random.seed(7)
+    transform = RawTransform((FEAT,))
+    if stall_s:
+        transform = StallTransform(transform, stall_s)
+    it = _loader(dataset, num_workers=workers, transform=transform,
+                 label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    kw = {}
+    if seed:
+        kw["arg_params"] = {k: v.copy() for k, v in _seed_init().items()}
+    if stop_after is not None:
+        calls = [0]
+
+        def cb(_param):
+            calls[0] += 1
+            if calls[0] >= stop_after:
+                raise _Stop()
+
+        kw["batch_end_callback"] = cb
+    try:
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                checkpoint=ckpt, resume_from=resume, **kw)
+    except _Stop:
+        pass
+    finally:
+        it.close()
+    arg, aux = mod.get_params()
+    w = {k: v.asnumpy().copy() for k, v in arg.items()}
+    w.update({k: v.asnumpy().copy() for k, v in aux.items()})
+    return w
+
+
+def _assert_equal(w0, w1):
+    assert set(w0) == set(w1)
+    for k in sorted(w0):
+        np.testing.assert_array_equal(w0[k], w1[k], err_msg=k)
+
+
+def test_fit_trains_from_the_loader(dataset):
+    w = _fit(dataset, epochs=1, workers=2)
+    assert all(np.isfinite(v).all() for v in w.values())
+
+
+def test_checkpoint_manifest_carries_the_cursor(dataset, tmp_path):
+    base = str(tmp_path / "ckpt")
+    ck = CheckpointConfig(base, every_n_batches=3, period_epochs=1)
+    _fit(dataset, epochs=1, workers=2, ckpt=ck, stop_after=7)
+    cur = restore_latest(base).data_cursor
+    assert cur is not None
+    assert cur["version"] == 1
+    assert cur["epoch"] == 0
+    assert cur["batches_done"] == 6      # last every-3 save before stop
+    assert cur["seed"] == 11 and cur["batch_size"] == BATCH
+    assert cur["num_records"] == NREC and cur["num_workers"] == 2
+
+
+def test_mid_epoch_resume_with_different_workers_is_bit_identical(
+        dataset, tmp_path):
+    """The headline drill, in-process: crash mid-epoch-1, resume with a
+    DIFFERENT worker count, land bit-identical to uninterrupted."""
+    w_ref = _fit(dataset, epochs=2, workers=2)
+    base = str(tmp_path / "ckpt")
+    ck = CheckpointConfig(base, every_n_batches=3, period_epochs=1)
+    _fit(dataset, epochs=2, workers=2, ckpt=ck, stop_after=15)
+    assert restore_latest(base).mid_epoch
+    w_res = _fit(dataset, epochs=2, workers=4, resume=base, seed=False)
+    _assert_equal(w_ref, w_res)
+    # and with the multiprocessing pool disabled entirely
+    w_res0 = _fit(dataset, epochs=2, workers=0, resume=base, seed=False)
+    _assert_equal(w_ref, w_res0)
+
+
+def test_epoch_boundary_resume_is_bit_identical(dataset, tmp_path):
+    w_ref = _fit(dataset, epochs=2, workers=2)
+    base = str(tmp_path / "ckpt")
+    ck = CheckpointConfig(base, period_epochs=1)
+    _fit(dataset, epochs=1, workers=2, ckpt=ck)
+    w_res = _fit(dataset, epochs=2, workers=1, resume=base, seed=False)
+    _assert_equal(w_ref, w_res)
+
+
+# -------------------------------------------------- straggler window honesty
+
+class _RecordingPublisher(object):
+    """FitPublisher stand-in: records the work_s stream fit feeds it."""
+
+    instances = []
+
+    def __init__(self):
+        self.windows = []
+        self.published = []
+        _RecordingPublisher.instances.append(self)
+
+    @classmethod
+    def create(cls):
+        return cls()
+
+    def step(self, work_s):
+        self.windows.append(float(work_s))
+
+    def publish(self, epoch):
+        self.published.append(int(epoch))
+
+
+def test_straggler_window_excludes_offthread_loader_stall(
+        dataset, monkeypatch):
+    """PR 13 regression, re-derived for the streaming loader: a SLOW
+    LOADER shows up as loop_prefetch_stall/data_stall, never as
+    inter-step local work that would flag this rank a straggler."""
+    from mxnet_tpu.obs import straggler as straggler_mod
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setattr(straggler_mod, "FitPublisher",
+                        _RecordingPublisher)
+    _RecordingPublisher.instances = []
+    stall_before = (profiler.get_counter("data_stall")
+                    + profiler.get_counter("loop_prefetch_stall"))
+    _fit(dataset, epochs=1, workers=1, stall_s=0.02)
+    [pub] = _RecordingPublisher.instances
+    assert pub.published == [0]
+    assert pub.windows, "fit never fed the straggler publisher"
+    # 12 batches x 4 records x 20ms decode stall ≈ 1s of loader latency;
+    # NONE of it may land in the local-work window
+    assert max(pub.windows) < 0.05, (
+        "loader stall leaked into the straggler local-work window: %r"
+        % (pub.windows,))
+    stalled = (profiler.get_counter("data_stall")
+               + profiler.get_counter("loop_prefetch_stall"))
+    assert stalled > stall_before, \
+        "a slow loader must surface as a data-plane stall counter"
+
+
+def test_inline_iterator_decode_still_counts_as_local_work(
+        dataset, monkeypatch):
+    """The flip side: num_workers=0 decodes ON the consumer thread —
+    that IS rank-local work and stays inside the window (an actually
+    slow host must not be able to hide behind the loader). The
+    device-prefetch wrap is disabled: wrapped, the fetch moves to the
+    prefetch thread and is legitimately off-thread."""
+    from mxnet_tpu.obs import straggler as straggler_mod
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setattr(straggler_mod, "FitPublisher",
+                        _RecordingPublisher)
+    _RecordingPublisher.instances = []
+    cfg.set("MXNET_TPU_DEVICE_PREFETCH", 0)
+    try:
+        _fit(dataset, epochs=1, workers=0, stall_s=0.02)
+    finally:
+        cfg.reset("MXNET_TPU_DEVICE_PREFETCH")
+    [pub] = _RecordingPublisher.instances
+    assert pub.windows
+    # each inline fetch decodes BATCH records x 20ms inside the window
+    assert max(pub.windows) > 0.05, (
+        "inline decode time vanished from the local-work window: %r"
+        % (pub.windows,))
+
+
+# ------------------------------------------------------------ zero-cost gate
+
+def test_unused_loader_is_never_imported(tmp_path):
+    """A fit fed by NDArrayIter must not import mxnet_tpu.data (lazy
+    module) nor touch any data_* counter — subprocess-proven."""
+    prog = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+X = np.random.RandomState(0).uniform(-1, 1, (32, 6)).astype(np.float32)
+Y = (np.arange(32) % 3).astype(np.float32)
+it = mx.io.NDArrayIter(X, Y, batch_size=4, label_name="softmax_label")
+data = mx.sym.Variable("data")
+fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+mod = mx.mod.Module(mx.sym.SoftmaxOutput(fc, name="softmax"),
+                    context=mx.cpu())
+mod.fit(it, num_epoch=1, optimizer="sgd")
+assert "mxnet_tpu.data" not in sys.modules, "loader imported unused"
+bad = [n for n in ("data_batches", "data_records", "data_stall",
+                   "data_worker_respawn", "data_batch_poisoned")
+       if profiler.get_counter(n)]
+assert not bad, "counters touched without the loader: %r" % bad
+print("ZERO_COST_OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", prog], cwd=REPO, capture_output=True,
+        text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr
+    assert "ZERO_COST_OK" in proc.stdout

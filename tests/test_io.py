@@ -245,3 +245,97 @@ def test_image_record_iter_sharding(tmp_path):
     with pytest.raises(ValueError):
         mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
                               batch_size=5, num_parts=2, part_index=2)
+
+
+# ----------------------------------------------- recordio index validation
+
+def _tamper_dataset(tmp_path, n=6):
+    """A healthy indexed record file the tamper tests then corrupt."""
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), b"payload-%d" % i))
+    w.close()
+    return rec_path, idx_path
+
+
+def test_indexed_recordio_rejects_offset_past_eof(tmp_path):
+    """A stale/corrupt .idx whose offset cannot hold a record header is
+    rejected AT OPEN with the index key named — not later as an opaque
+    struct error from whatever read_idx happens to hit it."""
+    from mxnet_tpu import recordio
+    rec_path, idx_path = _tamper_dataset(tmp_path)
+    size = os.path.getsize(rec_path)
+    with open(idx_path) as fin:
+        lines = fin.read().splitlines()
+    lines[3] = "3\t%d" % (size + 100)          # key 3 -> past EOF
+    with open(idx_path, "w") as fout:
+        fout.write("\n".join(lines) + "\n")
+    with pytest.raises(IOError) as err:
+        recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    msg = str(err.value)
+    assert "3" in msg and idx_path in msg and "stale or corrupt" in msg
+
+
+def test_indexed_recordio_rejects_malformed_index_line(tmp_path):
+    from mxnet_tpu import recordio
+    rec_path, idx_path = _tamper_dataset(tmp_path)
+    with open(idx_path, "a") as fout:
+        fout.write("not-a-key\n")
+    with pytest.raises(IOError) as err:
+        recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert "malformed index entry" in str(err.value)
+    assert idx_path in str(err.value)
+
+
+def test_indexed_recordio_names_key_on_bad_magic(tmp_path):
+    """An in-bounds offset that lands mid-record: the magic check fires
+    and read_idx names the index key, offset, and file."""
+    from mxnet_tpu import recordio
+    rec_path, idx_path = _tamper_dataset(tmp_path)
+    good = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    off = good.idx[2]
+    good.close()
+    with open(idx_path) as fin:
+        lines = fin.read().splitlines()
+    lines[2] = "2\t%d" % (off + 2)             # mid-record: valid bound,
+    with open(idx_path, "w") as fout:          # garbage magic
+        fout.write("\n".join(lines) + "\n")
+    bad = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert bad.read_idx(1)                     # neighbors still fine
+    with pytest.raises(IOError) as err:
+        bad.read_idx(2)
+    msg = str(err.value)
+    assert "key 2" in msg and "magic" in msg.lower()
+    bad.close()
+
+
+def test_indexed_recordio_names_key_on_truncated_payload(tmp_path):
+    """The record file ends mid-payload: the error names the promised
+    vs available bytes and the index key being read."""
+    from mxnet_tpu import recordio
+    rec_path, idx_path = _tamper_dataset(tmp_path)
+    good = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    last = good.idx[5]
+    good.close()
+    with open(rec_path, "r+b") as f:
+        f.truncate(last + 10)                  # header intact, payload cut
+    bad = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    with pytest.raises(IOError) as err:
+        bad.read_idx(5)
+    msg = str(err.value)
+    assert "key 5" in msg and "truncated" in msg
+    bad.close()
+
+
+def test_indexed_recordio_missing_key_is_legible(tmp_path):
+    from mxnet_tpu import recordio
+    rec_path, idx_path = _tamper_dataset(tmp_path)
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    with pytest.raises(KeyError) as err:
+        r.read_idx(99)
+    assert "99" in str(err.value) and idx_path in str(err.value)
+    r.close()

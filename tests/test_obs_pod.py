@@ -215,6 +215,25 @@ def test_straggler_silent_on_balanced_pod(tmp_path):
     assert r0["publish_failed"] == 0, r0
 
 
+@pytest.mark.slow
+def test_straggler_silent_on_slow_loader(tmp_path):
+    """ISSUE 17 satellite (re-derived inter-step window): rank 0 feeds
+    through a DataLoader stalled ~8x past the balanced work floor — a
+    slow DATA PLANE. It must surface as data_stall/loop_prefetch_stall
+    on that rank, never as a straggler flag (the off-thread fetch
+    re-mark in base_module.fit keeps loader waits out of the
+    local-work window)."""
+    results, dump = _run_pod("slowloader", tmp_path)
+    r0 = results[0]
+    assert r0["obs_straggler"] == 0, (r0, dump)
+    assert r0["block"] is None or r0["block"]["stragglers"] == [], \
+        (r0, dump)
+    # the slowness is visible where it belongs: the data plane
+    assert r0["data_stall"] + r0["loop_prefetch_stall"] > 0, (r0, dump)
+    # the unstalled rank sees no data-plane bubbles worth flagging
+    assert results[1]["obs_straggler"] == 0, results[1]
+
+
 def test_single_process_dump_keeps_default_name(tmp_path, monkeypatch):
     """No pod -> no suffix: the default filename stays profile.json and
     an explicit set_config() filename is always respected."""

@@ -1,0 +1,301 @@
+"""End-to-end drill for mx.data — the streaming data plane (CI `data`
+job, also driven by tests; ISSUE 17 acceptance).
+
+Four phases, every subprocess wait under a hard timeout (PhaseGuard
+discipline — a wedged drill fails, it does not hang the pipeline):
+
+1. **scaling smoke** — ``tools/perf/data_bench.py --quick``: worker
+   scaling on the decode-bound pipeline (gate >= 1.5x at 4 workers)
+   plus the steady-state ZERO ``data_stall`` / ZERO ``loop_recompile``
+   counter-asserts through a real fit.
+2. **worker-kill recovery** — a child streams an epoch with
+   ``data.worker:sigkill`` armed: every worker's gen-0 corpse is
+   respawned over exactly its undelivered range and the delivered
+   stream must be IDENTICAL to an unfaulted epoch
+   (``data_worker_respawn`` > 0 proves the deaths happened).
+3. **zero-cost gate** — a plain 8-device fit fed by ``NDArrayIter``
+   must never import ``mxnet_tpu.data`` (lazy module) nor move any
+   ``data_*`` counter.
+4. **kill -9 / reshard / resume parity** — the PR 10 drill composed
+   with the data plane: an 8-device fit streaming through a 2-worker
+   ``DataLoader`` is SIGKILLed mid-epoch (no preempt save — resume
+   rides the last async batch checkpoint and its loader cursor); the
+   second attempt resumes on 4 devices with 4 workers and is killed
+   again; the third finishes on 2 devices with 1 worker. Final params
+   must be BIT-IDENTICAL to an uninterrupted 8-device baseline, with
+   zero steady-state recompiles asserted at every batch of every
+   attempt. The model is elastic_smoke's one-hot "lookup regression"
+   (each gradient element has exactly one nonzero contributor, so
+   parity is immune to FP reduction order across mesh sizes).
+
+Exit 0 + ``DATA-DRILL-OK`` on success; any assertion kills CI.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+BATCH, NSAMP, FEAT, OUT = 8, 64, 64, 4
+EPOCHS = 3
+SEED = 5
+PHASE_TIMEOUT = 420.0
+# (devices, MXNET_TPU_DATA_WORKERS, fault) per attempt: two mid-epoch
+# SIGKILLs, then run to completion — every attempt changes BOTH the
+# device world and the worker count
+ATTEMPTS = [(8, "2", "fit.batch@5:sigkill"),
+            (4, "4", "fit.batch@4:sigkill"),
+            (2, "1", None)]
+
+
+def _dataset(dirpath):
+    """One-hot lookup records: record i's payload is e_{i mod FEAT},
+    its label a fixed random OUT-vector — the exact-parity dataset of
+    tools/elastic_smoke.py, packed as indexed RecordIO."""
+    import mxnet_tpu as mx
+    rec = os.path.join(dirpath, "onehot.rec")
+    idx = os.path.join(dirpath, "onehot.idx")
+    if os.path.exists(rec):
+        return rec, idx
+    x = np.eye(FEAT, dtype=np.float32)[np.arange(NSAMP) % FEAT]
+    rng = np.random.RandomState(3)
+    y = rng.uniform(-1, 1, (NSAMP, OUT)).astype(np.float32)
+    w = mx.recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(NSAMP):
+        w.write_idx(i, mx.recordio.pack(
+            mx.recordio.IRHeader(OUT, y[i], i, 0), x[i].tobytes()))
+    w.close()
+    return rec, idx
+
+
+def _loader(rec, idx):
+    import mxnet_tpu as mx
+    return mx.data.DataLoader(
+        rec, idx_path=idx, batch_size=BATCH,
+        transform=mx.data.RawTransform((FEAT,), label_width=OUT),
+        shuffle=True, seed=SEED, queue_depth=8, part=(0, 1),
+        label_name="label")
+
+
+def _symbol():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=OUT, no_bias=True,
+                               name="lut")
+    return mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("label"),
+                                         name="reg")
+
+
+def _train(data_dir, ckpt_dir=None, out_path=None,
+           check_recompiles=False):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    mx.random.seed(SEED)
+    ndev = len(jax.devices())
+    rec, idx = _dataset(data_dir)
+    it = _loader(rec, idx)
+    mod = mx.mod.Module(_symbol(), context=[mx.cpu(i) for i in
+                                            range(ndev)]
+                        if ndev > 1 else mx.cpu(),
+                        data_names=("data",), label_names=("label",))
+    kw = {}
+    if ckpt_dir is not None:
+        kw["checkpoint"] = mx.checkpoint.CheckpointConfig(
+            ckpt_dir, every_n_batches=2, period_epochs=1, keep_last=0)
+        kw["resume_from"] = ckpt_dir if \
+            mx.checkpoint.list_checkpoints(ckpt_dir) else None
+    if check_recompiles:
+        def _no_recompiles(_param):
+            n = profiler.get_counter("loop_recompile")
+            assert n == 0, "steady-state recompile detected (%d)" % n
+        kw["batch_end_callback"] = _no_recompiles
+    try:
+        mod.fit(it, num_epoch=EPOCHS, eval_metric="mse",
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.3,
+                                  "momentum": 0.9}, **kw)
+    finally:
+        it.close()
+    arg, _aux = mod.get_params()
+    w = {k: v.asnumpy() for k, v in arg.items()}
+    if out_path is not None:
+        np.savez(out_path, **w)
+    return ndev, w
+
+
+# --------------------------------------------------------- child bodies
+
+def _child_attempt(data_dir, ckpt_dir, out_path):
+    from mxnet_tpu import faults, profiler
+    spec = os.environ.get("MXNET_TPU_SMOKE_FAULT")
+    if spec:
+        faults.install(spec)
+    ndev, _w = _train(data_dir, ckpt_dir=ckpt_dir, out_path=out_path,
+                      check_recompiles=True)
+    print("DATA-CHILD-DONE world=%d workers=%s respawns=%d "
+          "recompiles=%d stalls=%d"
+          % (ndev, os.environ.get("MXNET_TPU_DATA_WORKERS"),
+             profiler.get_counter("data_worker_respawn"),
+             profiler.get_counter("loop_recompile"),
+             profiler.get_counter("data_stall")))
+    return 0
+
+
+def _child_killworkers(data_dir, out_path):
+    """Stream one epoch with every worker's gen-0 process SIGKILLed by
+    the data.worker fault; write the delivered stream + counters."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import faults, profiler
+    rec, idx = _dataset(data_dir)
+    stream = []
+    dl = _loader(rec, idx)
+    if os.environ.get("MXNET_TPU_SMOKE_FAULT"):
+        faults.install(os.environ["MXNET_TPU_SMOKE_FAULT"])
+    for batch in dl:
+        stream.append(np.argmax(batch.data[0], axis=1).tolist())
+    dl.close()
+    with open(out_path, "w") as f:
+        json.dump({"stream": stream,
+                   "respawns": profiler.get_counter(
+                       "data_worker_respawn")}, f)
+    print("KILLWORKERS-CHILD-DONE")
+    return 0
+
+
+def _child_zero_cost(data_dir):
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    import jax
+    mx.random.seed(SEED)
+    ndev = len(jax.devices())
+    x = np.eye(FEAT, dtype=np.float32)[np.arange(NSAMP) % FEAT]
+    y = np.random.RandomState(3).uniform(
+        -1, 1, (NSAMP, OUT)).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": x}, {"label": y}, batch_size=BATCH)
+    mod = mx.mod.Module(_symbol(), context=[mx.cpu(i) for i in
+                                            range(ndev)],
+                        data_names=("data",), label_names=("label",))
+    mod.fit(it, num_epoch=1, eval_metric="mse", optimizer="sgd")
+    assert "mxnet_tpu.data" not in sys.modules, \
+        "mxnet_tpu.data imported by a fit that never used it"
+    bad = {n: profiler.get_counter(n)
+           for n in ("data_batches", "data_records", "data_stall",
+                     "data_worker_respawn", "data_batch_poisoned")
+           if profiler.get_counter(n)}
+    assert not bad, "data_* counters moved without the loader: %r" % bad
+    print("ZERO-COST-OK")
+    return 0
+
+
+# --------------------------------------------------------------- driver
+
+def _run(argv, env, timeout=PHASE_TIMEOUT, expect_rc=0):
+    proc = subprocess.run(argv, env=env, capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO)
+    dump = "rc=%s\n--- stdout\n%s\n--- stderr\n%s" % (
+        proc.returncode, proc.stdout[-4000:], proc.stderr[-4000:])
+    assert proc.returncode == expect_rc, dump
+    return proc, dump
+
+
+def main():
+    me = os.path.abspath(__file__)
+    if "--attempt" in sys.argv:
+        i = sys.argv.index("--attempt")
+        return _child_attempt(sys.argv[i + 1], sys.argv[i + 2],
+                              sys.argv[i + 3])
+    if "--baseline" in sys.argv:
+        i = sys.argv.index("--baseline")
+        _ndev, _w = _train(sys.argv[i + 1], out_path=sys.argv[i + 2])
+        print("BASELINE-DONE")
+        return 0
+    if "--killworkers" in sys.argv:
+        i = sys.argv.index("--killworkers")
+        return _child_killworkers(sys.argv[i + 1], sys.argv[i + 2])
+    if "--zero-cost" in sys.argv:
+        return _child_zero_cost(sys.argv[sys.argv.index("--zero-cost")
+                                         + 1])
+
+    work = tempfile.mkdtemp(prefix="data_smoke_")
+    env_base = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    for k in ("MXNET_TPU_FAULTS", "MXNET_TPU_SMOKE_FAULT",
+              "MXNET_TPU_DATA_WORKERS", "MXNET_TPU_DATA_MP",
+              "MXNET_TPU_CKPT_TEST_CRASH"):
+        env_base.pop(k, None)
+
+    # ---- 1. scaling + steady-state gates (the bench's own GATE) -------
+    _p, _d = _run([sys.executable,
+                   os.path.join(REPO, "tools", "perf", "data_bench.py"),
+                   "--quick"], env_base)
+    print("phase 1 ok: scaling + zero-stall/zero-recompile gates")
+
+    # ---- 2. worker-kill recovery: stream identical, respawns > 0 ------
+    ref_json = os.path.join(work, "stream-ref.json")
+    kill_json = os.path.join(work, "stream-kill.json")
+    _run([sys.executable, me, "--killworkers", work, ref_json],
+         {**env_base, "MXNET_TPU_DATA_WORKERS": "2"})
+    _p, dump = _run([sys.executable, me, "--killworkers", work,
+                     kill_json],
+                    {**env_base, "MXNET_TPU_DATA_WORKERS": "2",
+                     "MXNET_TPU_SMOKE_FAULT": "data.worker@1:sigkill"})
+    ref = json.load(open(ref_json))
+    killed = json.load(open(kill_json))
+    assert killed["respawns"] >= 1, (killed, dump)
+    assert killed["stream"] == ref["stream"], \
+        "worker-kill replay diverged\n" + dump
+    print("phase 2 ok: %d respawns, stream identical"
+          % killed["respawns"])
+
+    # ---- 3. zero-cost gate --------------------------------------------
+    flags = "--xla_force_host_platform_device_count=8"
+    _run([sys.executable, me, "--zero-cost", work],
+         {**env_base, "XLA_FLAGS": flags})
+    print("phase 3 ok: unused loader never imported, counters silent")
+
+    # ---- 4. kill -9 / reshard / resume parity -------------------------
+    base_npz = os.path.join(work, "baseline.npz")
+    final_npz = os.path.join(work, "final.npz")
+    ckpt_dir = os.path.join(work, "ckpts")
+    _run([sys.executable, me, "--baseline", work, base_npz],
+         {**env_base, "XLA_FLAGS": flags, "MXNET_TPU_DATA_WORKERS": "2"})
+    for att, (ndev, workers, fault) in enumerate(ATTEMPTS):
+        env = {**env_base,
+               "XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=%d" % ndev,
+               "MXNET_TPU_DATA_WORKERS": workers}
+        if fault:
+            env["MXNET_TPU_SMOKE_FAULT"] = fault
+        proc, dump = _run(
+            [sys.executable, me, "--attempt", work, ckpt_dir,
+             final_npz], env,
+            expect_rc=-signal.SIGKILL if fault else 0)
+        if fault:
+            assert "DATA-CHILD-DONE" not in proc.stdout, dump
+            print("attempt %d: killed -9 mid-epoch at %d devices / %s "
+                  "workers" % (att, ndev, workers))
+        else:
+            assert "DATA-CHILD-DONE" in proc.stdout, dump
+            print("attempt %d: completed at %d devices / %s workers"
+                  % (att, ndev, workers))
+    ref = np.load(base_npz)
+    got = np.load(final_npz)
+    assert set(ref.files) == set(got.files)
+    for k in ref.files:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+    print("phase 4 ok: 8->4->2 devices, 2->4->1 workers, kill -9 x2, "
+          "params bit-identical to uninterrupted")
+
+    print("DATA-DRILL-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

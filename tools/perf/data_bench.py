@@ -1,0 +1,177 @@
+"""mx.data throughput bench: img/s vs worker count on a decode-bound
+pipeline (ISSUE 17 — the streaming data plane).
+
+The pipeline is made decode-bound with ``StallTransform``: a fixed
+per-record stall emulating remote-storage fetch / decode latency. This
+is deliberate — CI boxes for this repo have ONE cpu core, so a
+cpu-bound decode cannot scale with processes there (numpy decode is
+serialized on the core); latency-bound decode is both the honest
+regime for the claim being benched (workers OVERLAP waiting, which is
+what a pod's input pipeline actually amortizes — storage fetch, not
+arithmetic) and the regime the acceptance gate pins: **>= 1.5x img/s
+at 4 workers vs 1**.
+
+The bench also counter-asserts the steady-state discipline from the
+ISSUE: with enough workers the consumer must see ZERO ``data_stall``
+bubbles while a real fit consumes the stream, and the fit must not
+recompile past its first batch (``xla_compile_ms`` count stable).
+
+Usage: python tools/perf/data_bench.py [--quick] [--json PATH]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+BATCH = 8
+FEAT = 64
+STALL_S = 0.004           # per-record "storage fetch" latency
+
+
+def _dataset(tmpdir, n):
+    import mxnet_tpu as mx
+    rec = os.path.join(tmpdir, "bench.rec")
+    idx = os.path.join(tmpdir, "bench.idx")
+    rng = np.random.RandomState(0)
+    w = mx.recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        w.write_idx(i, mx.recordio.pack(
+            mx.recordio.IRHeader(0, float(i % 3), i, 0),
+            rng.uniform(-1, 1, FEAT).astype(np.float32).tobytes()))
+    w.close()
+    return rec, idx
+
+
+def _loader(rec, idx, workers, stall_s=STALL_S):
+    import mxnet_tpu as mx
+    transform = mx.data.RawTransform((FEAT,))
+    if stall_s:
+        transform = mx.data.StallTransform(transform, stall_s)
+    return mx.data.DataLoader(
+        rec, idx_path=idx, batch_size=BATCH, transform=transform,
+        shuffle=True, seed=3, num_workers=workers, queue_depth=8,
+        part=(0, 1), label_name="softmax_label")
+
+
+def bench_scaling(rec, idx, worker_counts, epochs):
+    """Pure-iteration img/s per worker count (no model: the loader is
+    the system under test)."""
+    out = {}
+    for workers in worker_counts:
+        dl = _loader(rec, idx, workers)
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            for batch in dl:
+                n += batch.data[0].shape[0]
+            dl.reset()
+        dt = time.perf_counter() - t0
+        dl.close()
+        out[workers] = {"records": n, "wall_s": round(dt, 3),
+                        "img_per_s": round(n / dt, 1)}
+        print("  %d worker(s): %7.1f img/s  (%d records in %.2fs)"
+              % (workers, n / dt, n, dt))
+    return out
+
+
+def bench_steady_state_fit(rec, idx, workers):
+    """A real fit over an UNSTALLED stream — the steady state, where
+    decode keeps up with the step: assert zero loader stalls and zero
+    steady-state recompiles. (The stalled scaling pipeline above is
+    decode-bound by construction; its bubbles are the measurement, not
+    a regression.)"""
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    dl = _loader(rec, idx, workers, stall_s=0.0)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    stall0 = profiler.get_counter("data_stall")
+    mx.random.seed(0)
+    # epoch 0 warms the jit cache; loop_recompile already only counts
+    # executable-cache growth PAST the warmup compile
+    mod.fit(dl, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    compiles0 = profiler.get_counter("loop_recompile")
+    t0 = time.perf_counter()
+    mod.fit(dl, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    wall = time.perf_counter() - t0
+    stalls = profiler.get_counter("data_stall") - stall0
+    recompiles = profiler.get_counter("loop_recompile") - compiles0
+    batches = profiler.get_counter("data_batches")
+    dl.close()
+    return {"workers": workers, "fit_wall_s": round(wall, 3),
+            "batches_delivered": batches,
+            "steady_state_stalls": stalls,
+            "steady_state_recompiles": recompiles}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import tempfile
+    import mxnet_tpu as mx  # noqa: F401 (forces full import before timing)
+
+    n = 64 if args.quick else 192
+    epochs = 1 if args.quick else 2
+    tmpdir = tempfile.mkdtemp(prefix="mx-data-bench-")
+    rec, idx = _dataset(tmpdir, n)
+
+    t_start = time.perf_counter()
+    print("scaling (decode-bound: %.0fms/record stall, batch %d):"
+          % (STALL_S * 1e3, BATCH))
+    scaling = bench_scaling(rec, idx, (1, 2, 4), epochs)
+    speedup_4v1 = round(
+        scaling[4]["img_per_s"] / scaling[1]["img_per_s"], 2)
+    print("  4-worker vs 1-worker speedup: %.2fx (gate: >= 1.5x)"
+          % speedup_4v1)
+
+    steady = bench_steady_state_fit(rec, idx, workers=4)
+    print("steady-state fit: %d stalls, %d recompiles"
+          % (steady["steady_state_stalls"],
+             steady["steady_state_recompiles"]))
+
+    results = {
+        "stall_ms_per_record": STALL_S * 1e3,
+        "records": n,
+        "batch_size": BATCH,
+        "scaling": {str(k): v for k, v in scaling.items()},
+        "speedup_4workers_vs_1": speedup_4v1,
+        "steady_state": steady,
+        "note": ("latency-bound pipeline (StallTransform): the CI host "
+                 "has 1 cpu core, so worker scaling is demonstrated on "
+                 "overlapped IO latency, the regime a pod input "
+                 "pipeline actually amortizes"),
+    }
+    payload = {"bench": "data", "quick": bool(args.quick),
+               "elapsed_s": round(time.perf_counter() - t_start, 1),
+               "results": results}
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=False)
+        print("wrote", args.json)
+
+    ok = speedup_4v1 >= 1.5 and steady["steady_state_stalls"] == 0 \
+        and steady["steady_state_recompiles"] == 0
+    print("GATE:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
