@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
+from . import lockcheck as _lockcheck
 from . import profiler as _profiler
 
 __all__ = [
@@ -111,7 +112,7 @@ class CompileCache:
         self._entries: Dict[Any, Any] = {}
         # sig -> [failure_count, permanent]
         self._failures: Dict[Any, List] = {}
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.Lock(name="fused.cache_lock")
 
     def get(self, sig):
         with self._lock:

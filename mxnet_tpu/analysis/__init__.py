@@ -28,6 +28,15 @@ Finding/Report/Severity vocabulary:
   (lock-host-sync / lock-dispatch / wall-clock / eager-loop-sync /
   signal-unsafe), with inline ``# mx-lint: allow(code)`` suppressions
   and a CI baseline that fails on drift in either direction.
+* :mod:`.concurrency` — the whole-program lock-order pass riding the
+  same lint entry points: names every lock object in the package,
+  builds the acquires-while-holding graph with calls resolved one
+  level through package-local helpers, and reports
+  ``lock-order-cycle`` (ERROR, both chains with file:line),
+  interprocedural ``lock-host-sync`` (a helper syncing under the
+  caller's lock), and ``unlocked-shared-state`` (WARNING). Its runtime
+  twin is ``mxnet_tpu.lockcheck`` (``MXNET_TPU_LOCKCHECK=off|warn|
+  abort``), which witnesses the ACTUAL acquisition order online.
 
 Bind-time enforcement rides the ``MXNET_TPU_ANALYZE=off|warn|strict`` knob
 (:func:`check_bind`, called from ``Executor.__init__``): ``warn`` logs
@@ -53,7 +62,8 @@ from .memory_passes import analyze_program_memory, parse_bytes
 from .program_passes import analyze_jaxpr, analyze_program
 from .lint import (baseline_key, diff_baseline, lint_paths, lint_source,
                    load_baseline, stale_baseline, write_baseline)
-from . import memory_passes, roofline, sharding_passes
+from .concurrency import analyze_sources
+from . import concurrency, memory_passes, roofline, sharding_passes
 from .sharding_passes import (analyze_collectives, analyze_module_sharding,
                               check_islands, check_replicated, check_specs)
 
@@ -63,8 +73,8 @@ __all__ = [
     "analyze_program_memory", "parse_bytes",
     "analyze_collectives", "analyze_module_sharding",
     "check_specs", "check_islands", "check_replicated",
-    "memory_passes", "sharding_passes", "roofline",
-    "lint_paths", "lint_source",
+    "memory_passes", "sharding_passes", "roofline", "concurrency",
+    "lint_paths", "lint_source", "analyze_sources",
     "load_baseline", "write_baseline", "diff_baseline", "stale_baseline",
     "baseline_key",
     "check_bind", "GRAPH_PASSES",

@@ -206,6 +206,20 @@ class _FileLinter(ast.NodeVisitor):
                     "jax dispatch %r under lock(s) [%s] — the accelerator "
                     "is serialized behind a Python mutex" % (name, locks),
                     line)
+
+        # bare acquire()/release() participates in lock_stack exactly
+        # like `with` — the try/finally idiom must not be invisible to
+        # the under-lock checks above (function exit still resets the
+        # stack, bounding an unmatched acquire to its function)
+        if isinstance(node.func, ast.Attribute):
+            recv = _dotted(node.func.value)
+            if leaf == "acquire" and _LOCK_NAME.search(recv):
+                self.lock_stack.append((recv, line))
+            elif leaf == "release" and _LOCK_NAME.search(recv):
+                for i in range(len(self.lock_stack) - 1, -1, -1):
+                    if self.lock_stack[i][0] == recv:
+                        del self.lock_stack[i]
+                        break
         self.generic_visit(node)
 
 
@@ -312,7 +326,12 @@ class _SignalScanner:
 
 
 def lint_source(source: str, path: str = "<string>",
-                report: Optional[Report] = None) -> Report:
+                report: Optional[Report] = None,
+                concurrency: bool = True) -> Report:
+    """Lint one source blob. ``concurrency=True`` (the default) also runs
+    the whole-program lock-order pass over this single file — right for
+    standalone snippets and fixtures; ``lint_paths`` passes ``False`` and
+    runs that pass ONCE over all files so cross-module cycles resolve."""
     report = report if report is not None else Report(context="lint")
     try:
         tree = ast.parse(source, filename=path)
@@ -323,12 +342,17 @@ def lint_source(source: str, path: str = "<string>",
         return report
     _FileLinter(path, source, report).visit(tree)
     _SignalScanner(path, source, report).scan(tree)
+    if concurrency:
+        from .concurrency import analyze_sources
+        analyze_sources([(path, source, tree)], report)
     return report
 
 
 def lint_paths(paths, report: Optional[Report] = None,
                exclude=("native/vendor",)) -> Report:
-    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    """Lint every ``.py`` file under ``paths`` (files or directories),
+    then run the whole-program concurrency pass over the full file set
+    (lock names and helper calls resolve across modules)."""
     report = report if report is not None else Report(context="lint")
     files: List[str] = []
     for p in paths:
@@ -343,9 +367,14 @@ def lint_paths(paths, report: Optional[Report] = None,
                 if f.endswith(".py") and not any(e in full
                                                  for e in exclude):
                     files.append(full)
+    units = []
     for f in sorted(files):
         with open(f, "r", encoding="utf-8") as fh:
-            lint_source(fh.read(), path=f, report=report)
+            src = fh.read()
+        lint_source(src, path=f, report=report, concurrency=False)
+        units.append((f, src))
+    from .concurrency import analyze_sources
+    analyze_sources(units, report)
     return report
 
 

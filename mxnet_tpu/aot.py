@@ -44,6 +44,7 @@ import pickle
 import threading
 from typing import Any, Callable, Iterable, Optional
 
+from . import lockcheck as _lockcheck
 from . import profiler as _profiler
 
 __all__ = [
@@ -235,7 +236,7 @@ def load_or_compile(name: str, key: str, jitted, *args):
 
 # ------------------------------------------------- persistent-cache fence
 
-_fence_lock = threading.Lock()
+_fence_lock = _lockcheck.Lock(name="aot.fence_lock")
 _fence_installed = False
 _tls = threading.local()
 

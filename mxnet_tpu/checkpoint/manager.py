@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import lockcheck as _lockcheck
 from . import format as _format
 from .format import (CheckpointCorrupt, CheckpointError,         # noqa: F401
                      CheckpointNotFound)
@@ -338,7 +339,7 @@ class CheckpointManager(object):
         self._last_error: Optional[BaseException] = None
         self._preempt = False
         self._closed = False
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.Lock(name="checkpoint.manager_lock")
         self._seq: Optional[int] = None
 
     # ------------------------------------------------------------ status

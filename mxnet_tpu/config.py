@@ -367,6 +367,30 @@ register("MXNET_TPU_NANCHECK", _parse_nancheck, "off",
          "(zero cost)")
 
 
+def _parse_lockcheck(v) -> str:
+    s = str(v).strip().lower()
+    if s in ("", "0", "off", "false", "no", "none"):
+        return "off"
+    if s in ("warn", "warning", "1", "on", "true", "yes"):
+        return "warn"
+    if s == "abort":
+        return "abort"
+    raise ValueError(
+        "MXNET_TPU_LOCKCHECK must be off|warn|abort, got %r" % (v,))
+
+
+register("MXNET_TPU_LOCKCHECK", _parse_lockcheck, "off",
+         "runtime lock witness: wrap locks created through the "
+         "mx.lockcheck funnels (serve scheduler, checkpoint writer, "
+         "obs, pod KV, ...) to record actual acquisition order and "
+         "flag the first observed lock-order inversion "
+         "(lockcheck_inversion) and any device sync under a held lock "
+         "(lockcheck_held_sync). warn = log both chains, abort = raise "
+         "MXNetError before the inversion's blocking acquire; off = "
+         "plain threading primitives, wrapper never constructed "
+         "(one module-bool per lock creation)")
+
+
 def _parse_remat(v) -> str:
     s = str(v).strip()
     low = s.lower()

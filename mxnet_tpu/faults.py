@@ -90,6 +90,7 @@ import signal
 import threading
 from typing import Dict, List, Optional
 
+from . import lockcheck as _lockcheck
 from .base import MXNetError
 
 __all__ = ["FaultInjected", "ARMED", "fire", "install", "clear",
@@ -154,7 +155,7 @@ class _Spec(object):
                             ":" + self.kind if self.kind else "")
 
 
-_lock = threading.Lock()
+_lock = _lockcheck.Lock(name="faults.lock")
 _specs: List[_Spec] = []
 _hits: Dict[str, int] = {}
 # clear() is final: armed_or_env() must not resurrect env-derived specs

@@ -15,6 +15,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import lockcheck as _lockcheck
 from .. import ndarray as nd
 from ..recordio import MXRecordIO, MXIndexedRecordIO, unpack
 from .io import DataBatch, DataDesc, DataIter
@@ -134,7 +135,7 @@ class ImageRecordIter(DataIter):
             self._part_index::self._num_parts]
         self._epoch_queue: "queue.Queue" = queue.Queue()
         self._batch_queue: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.Lock(name="io.image_record_lock")
         self._cursor = 0
         self._alive = True
         self._loader = threading.Thread(target=self._produce, daemon=True)

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from .. import lockcheck as _lockcheck
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from ..base import MXNetError
@@ -306,7 +307,8 @@ class PrefetchingIter(DataIter):
         self._queues = [queue.Queue(maxsize=prefetch_depth)
                         for _ in range(self.n_iter)]
         self._epoch = 0
-        self._iter_locks = [threading.Lock() for _ in range(self.n_iter)]
+        self._iter_locks = [_lockcheck.Lock(name="io.iter_lock[%d]" % i)
+                            for i in range(self.n_iter)]
         self._closed = False
         self._started = True
         self._first_fetch = True

@@ -1,0 +1,335 @@
+"""``MXNET_TPU_LOCKCHECK`` — the runtime lock witness (``off|warn|abort``).
+
+The static lock-order pass (``analysis/concurrency.py``) approximates
+acquisition order through one level of calls; this module records the
+order that *actually happens* — the lockset/witness half of the classic
+dynamic race tooling (Eraser, Savage et al. 1997; ThreadSanitizer,
+Serebryany & Iskhodzhanov 2009), scoped to our own locks.
+
+Our runtime modules create locks through the creation funnels below
+(:func:`Lock` / :func:`RLock` / :func:`Condition`) instead of calling
+``threading`` directly. With the knob off (the default) each funnel
+returns the plain ``threading`` primitive after ONE module-bool check —
+no wrapper object exists anywhere and no ``lockcheck_*`` counter ever
+moves (subprocess-proven by ``tests/test_lockcheck.py`` and the CI
+``analysis`` job, like every other knob). With ``warn``/``abort`` each
+lock created *afterwards* is wrapped in a :class:`_WitnessLock` that
+maintains a per-thread held-stack and a global site-keyed order graph:
+
+* **Inversion**: recording edge ``B -> A`` (B held while acquiring A)
+  when ``A -> B`` is already in the graph flags the ABBA shape — counter
+  ``lockcheck_inversion``, one report per unordered site pair: a
+  warning naming both acquisition chains under ``warn``, ``MXNetError``
+  *before the blocking acquire* under ``abort`` (the thread is stopped
+  at the inversion, not inside the deadlock it would cause).
+* **Held-lock host sync**: the NDArray sync points (``asnumpy`` /
+  ``asscalar`` / ``wait_to_read``) call :func:`note_sync`; a sync while
+  ANY witnessed lock is held counts ``lockcheck_held_sync`` and
+  warns/aborts — unless every held lock was created with
+  ``allow_sync=True``, the runtime twin of the static
+  ``# mx-lint: allow(lock-host-sync)`` justification.
+
+Discipline notes:
+
+* Graph nodes are CREATION SITES (``file:line`` plus the optional
+  ``name=``), not instances — two servers' ``_lock`` instances share a
+  node, so an ABBA between instances of the same class pair is still
+  caught; edges between two instances of ONE site are ignored (the
+  common address-ordered same-class pattern cannot be told apart from
+  an inversion statically-keyed this way).
+* Non-blocking try-acquires update held-state but record no edges: a
+  trylock never waits, so it cannot complete a deadlock cycle.
+* Reentrant re-acquires of a held RLock record no edges (one node, no
+  self-order); ``Condition.wait``'s release/re-acquire goes through
+  ``_release_save``/``_acquire_restore`` so held-state stays exact and
+  the re-acquire is witnessed like any other blocking acquire.
+* The witness's own state lives under a RAW ``threading.Lock`` and the
+  flag path (profiler counter, logging, raise) runs OUTSIDE it — the
+  recorder never feeds its own graph.
+
+The knob is read at lock creation: flipping it at runtime
+(``mx.config.set``) affects locks created from then on, which is what
+tests want (fresh objects per case) and keeps the off path free of any
+per-acquire mode check.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading as _threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import config as _config
+
+__all__ = ["Lock", "RLock", "Condition", "note_sync", "mode",
+           "reset_order_graph"]
+
+_MODE = "off"
+_ON = False
+
+
+def _set_mode(value: str) -> None:
+    global _MODE, _ON
+    _MODE = value
+    _ON = value != "off"
+
+
+_set_mode(_config.get("MXNET_TPU_LOCKCHECK"))
+_config.on_change("MXNET_TPU_LOCKCHECK", _set_mode)
+
+
+def mode() -> str:
+    """Current witness mode (``off``/``warn``/``abort``)."""
+    return _MODE
+
+
+# --------------------------------------------------------------- state
+# All raw threading primitives: the recorder must never witness itself.
+_graph_lock = _threading.Lock()
+# (site_a, site_b) -> human chain: how site_b was first acquired under a
+_edges: Dict[Tuple[str, str], str] = {}
+_flagged: Set[frozenset] = set()        # site pairs already reported
+_sync_flagged: Set[Tuple[str, str]] = set()
+_tls = _threading.local()
+
+
+def _held() -> List["_WitnessLock"]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def reset_order_graph() -> None:
+    """Forget every recorded edge and report (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+        _flagged.clear()
+        _sync_flagged.clear()
+
+
+def _shorten(fn: str) -> str:
+    for marker in ("mxnet_tpu", "tests", "tools"):
+        idx = fn.rfind(marker)
+        if idx >= 0:
+            return fn[idx:]
+    return fn
+
+
+def _caller_site(depth: int) -> str:
+    """file:line of the nearest frame OUTSIDE this module — the user's
+    ``with``/``acquire`` line, not our wrapper plumbing."""
+    try:
+        frame = sys._getframe(depth)
+        while frame is not None and frame.f_code.co_filename == __file__:
+            frame = frame.f_back
+        if frame is None:
+            return "<unknown>"
+        return "%s:%d" % (_shorten(frame.f_code.co_filename),
+                          frame.f_lineno)
+    except Exception:                                   # noqa: BLE001
+        return "<unknown>"
+
+
+def _abort(message: str) -> None:
+    from .base import MXNetError
+    raise MXNetError(message)
+
+
+def _flag_inversion(pair_msgs: List[str]) -> None:
+    from . import profiler as _profiler
+    for msg in pair_msgs:
+        _profiler.incr_counter("lockcheck_inversion")
+        full = ("lockcheck: lock-order inversion (ABBA) observed — %s. "
+                "Two threads taking these paths concurrently deadlock." % msg)
+        if _MODE == "abort":
+            _abort(full)
+        logging.getLogger(__name__).warning(full)
+
+
+class _WitnessLock:
+    """Order-witnessing wrapper around one ``threading`` primitive.
+
+    Duck-types the lock protocol (``acquire``/``release``/``locked``/
+    context manager) plus the private hooks ``threading.Condition``
+    probes for (``_is_owned``/``_release_save``/``_acquire_restore``),
+    so it can back a Condition transparently.
+    """
+
+    __slots__ = ("_inner", "_site", "_allow_sync", "_reentrant")
+
+    def __init__(self, inner, site: str, allow_sync: bool = False,
+                 reentrant: bool = False):
+        self._inner = inner
+        self._site = site
+        self._allow_sync = allow_sync
+        self._reentrant = reentrant
+
+    # ------------------------------------------------------ lock protocol
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            self._note_acquire()
+        got = self._inner.acquire(blocking, timeout) if blocking \
+            else self._inner.acquire(False)
+        if got:
+            _held().append(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        h = _held()
+        for i in range(len(h) - 1, -1, -1):
+            if h[i] is self:
+                del h[i]
+                break
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<witness %r wrapping %r>" % (self._site, self._inner)
+
+    # ------------------------------------------- Condition compatibility
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        # plain Lock: CPython's own probe, against the INNER lock so the
+        # witness records nothing for it
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        # Condition.wait drops the lock wholesale (all recursion levels)
+        h = _held()
+        n = 0
+        for i in range(len(h) - 1, -1, -1):
+            if h[i] is self:
+                del h[i]
+                n += 1
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), n)
+        self._inner.release()
+        return (None, n)
+
+    def _acquire_restore(self, saved):
+        state, n = saved
+        # the post-wait re-acquire blocks like any other acquisition —
+        # witness it (a cond re-acquire under an unrelated held lock is
+        # a genuine ordering event)
+        self._note_acquire()
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        _held().extend([self] * max(1, n))
+
+    # ------------------------------------------------------ order graph
+    def _note_acquire(self):
+        held = _held()
+        if not held:
+            return
+        if any(h is self for h in held):
+            return                       # reentrant: one node, no order
+        site_b = self._site
+        where = _caller_site(3)
+        thread = _threading.current_thread().name
+        inversions: List[str] = []
+        with _graph_lock:
+            for h in held:
+                site_a = h._site
+                if site_a == site_b:
+                    continue             # two instances of one site
+                key = (site_a, site_b)
+                chain = ("thread %r acquires lock[%s] at %s while "
+                         "holding lock[%s]" % (thread, site_b, where,
+                                               site_a))
+                if key not in _edges:
+                    _edges[key] = chain
+                rev = _edges.get((site_b, site_a))
+                pair = frozenset((site_a, site_b))
+                if rev is not None and pair not in _flagged:
+                    _flagged.add(pair)
+                    inversions.append("%s; but earlier %s"
+                                      % (chain, rev))
+        if inversions:
+            _flag_inversion(inversions)
+
+
+# ------------------------------------------------------------- funnels
+
+
+def Lock(name: Optional[str] = None, allow_sync: bool = False):
+    """``threading.Lock()`` through the witness funnel. ``allow_sync``
+    exempts the lock from held-sync flagging (a justified lock-held
+    device fetch, e.g. serve's ``_model_lock`` — pair it with the static
+    ``# mx-lint: allow(lock-host-sync)`` and a why-comment)."""
+    if not _ON:
+        return _threading.Lock()
+    site = name or _caller_site(2)
+    return _WitnessLock(_threading.Lock(), site, allow_sync=allow_sync)
+
+
+def RLock(name: Optional[str] = None, allow_sync: bool = False):
+    """``threading.RLock()`` through the witness funnel."""
+    if not _ON:
+        return _threading.RLock()
+    site = name or _caller_site(2)
+    return _WitnessLock(_threading.RLock(), site, allow_sync=allow_sync,
+                        reentrant=True)
+
+
+def Condition(lock=None, name: Optional[str] = None):
+    """``threading.Condition()`` through the witness funnel. A condition
+    sharing an already-witnessed lock is witnessed through it; a bare
+    ``Condition()`` gets a witnessed RLock like threading's default."""
+    if not _ON:
+        return _threading.Condition(lock)
+    if lock is None:
+        site = name or _caller_site(2)
+        lock = _WitnessLock(_threading.RLock(), site, reentrant=True)
+    return _threading.Condition(lock)
+
+
+# ---------------------------------------------------------- sync hook
+
+
+def note_sync(what: str = "host-sync") -> None:
+    """Called from the NDArray sync points (behind an ``if
+    lockcheck._ON`` module-bool so the off path costs one attribute
+    read): flag a device sync performed while witnessed locks are
+    held — the runtime ground truth behind the static
+    ``lock-host-sync`` pass."""
+    if not _ON:
+        return
+    held = [h for h in _held() if not h._allow_sync]
+    if not held:
+        return
+    where = _caller_site(2)
+    sites = ", ".join(h._site for h in held)
+    keys = [(h._site, what) for h in held]
+    with _graph_lock:
+        fresh = [k for k in keys if k not in _sync_flagged]
+        _sync_flagged.update(fresh)
+    if not fresh:
+        return
+    from . import profiler as _profiler
+    _profiler.incr_counter("lockcheck_held_sync", len(fresh))
+    msg = ("lockcheck: host sync %r at %s while holding lock(s) [%s] — "
+           "other threads queue behind the device; callback re-entry "
+           "deadlocks (the PR 2 train_rcnn shape). Create the lock with "
+           "allow_sync=True only with a justification comment."
+           % (what, where, sites))
+    if _MODE == "abort":
+        _abort(msg)
+    logging.getLogger(__name__).warning(msg)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from .. import autograd as _autograd
 from .. import config as _config
+from .. import lockcheck as _lockcheck
 from .. import profiler as _profiler
 from .. import random as _random
 
@@ -155,6 +156,8 @@ class NDArray:
         SyncCopyToCPU src/ndarray/ndarray.cc:779). A *copy*, like the
         reference: callers may mutate the result without touching the
         device buffer (np.asarray of a jax array is a read-only view)."""
+        if _lockcheck._ON:
+            _lockcheck.note_sync("asnumpy")
         out = np.asarray(self._data)
         if not out.flags.writeable:
             out = out.copy()
@@ -171,6 +174,8 @@ class NDArray:
     def wait_to_read(self) -> None:
         """Block until the async computation producing this array finishes
         (reference: ndarray.h:156 WaitToRead via Engine::WaitForVar)."""
+        if _lockcheck._ON:
+            _lockcheck.note_sync("wait_to_read")
         self._data.block_until_ready()
 
     wait_to_write = wait_to_read

@@ -49,6 +49,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import config as _config
+from .. import lockcheck as _lockcheck
 from .. import profiler as _profiler
 
 __all__ = ["enabled", "record", "flush", "set_identity",
@@ -59,13 +60,14 @@ ENV_DIR = "MXNET_TPU_OBS_BLACKBOX"
 # env vars whose values identify the run in the header fingerprint
 _FINGERPRINT_PREFIXES = ("MXNET_", "DMLC_", "JAX_PLATFORMS", "XLA_FLAGS")
 
-_lock = threading.Lock()          # install / identity / snapshot state
+_lock = _lockcheck.Lock(name="obs.blackbox.lock")   # install / identity
+                                                    # / snapshot state
 # serializes WHOLE flushes (snapshot + atomic write): without it a
 # periodic flush that snapshotted the ring before a terminal flush
 # (fault fire, SIGTERM) could finish its rename AFTER it and erase the
 # cause-of-death event from the on-disk window. Separate from _lock so
 # the disk write never blocks record()/identity state mutation.
-_flush_lock = threading.Lock()
+_flush_lock = _lockcheck.Lock(name="obs.blackbox.flush_lock")
 _seq = itertools.count(1)
 _ring: Optional[collections.deque] = None
 _installed = False

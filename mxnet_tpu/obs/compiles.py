@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .. import lockcheck as _lockcheck
 from .. import profiler as _profiler
 
 __all__ = ["scope", "install", "snapshot", "RING_CAPACITY"]
@@ -38,7 +39,7 @@ RING_CAPACITY = 256
 
 _ring: "collections.deque[Dict[str, Any]]" = \
     collections.deque(maxlen=RING_CAPACITY)
-_ring_lock = threading.Lock()
+_ring_lock = _lockcheck.Lock(name="obs.compiles.ring_lock")
 _tls = threading.local()
 _installed = False
 _t0 = time.perf_counter()

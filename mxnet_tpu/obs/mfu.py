@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import config as _config
+from .. import lockcheck as _lockcheck
 from .. import profiler as _profiler
 
 __all__ = ["peak_flops", "register_executor", "collect",
@@ -59,7 +60,7 @@ PEAK_FLOPS_BY_DEVICE_KIND = [
     ("v6", 918e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12)]
 _PEAK = PEAK_FLOPS_BY_DEVICE_KIND
 
-_reg_lock = threading.Lock()
+_reg_lock = _lockcheck.Lock(name="obs.mfu.reg_lock")
 # serializes whole collects: two concurrent collectors (report() + a
 # /metrics scrape) must not race the read-modify-write of each module's
 # rate baseline. Note the baseline itself is SHARED across consumers —
@@ -68,7 +69,7 @@ _reg_lock = threading.Lock()
 # steady-state estimates, just noisier. Benches following the
 # report()-after-warmup / report()-after-region recipe should not point
 # a concurrent scraper at the same process during the timed region.
-_collect_lock = threading.Lock()
+_collect_lock = _lockcheck.Lock(name="obs.mfu.collect_lock")
 _executors: List[weakref.ref] = []
 
 
@@ -180,7 +181,11 @@ def _collect_locked() -> List[Dict[str, Any]]:
             if token is not None:
                 try:
                     import jax
-                    jax.block_until_ready(token)
+                    # the rate window must close on COMPLETED device
+                    # work, and serializing whole collects (including
+                    # this wait) under _collect_lock IS the documented
+                    # shared-window semantics — see _collect_lock
+                    jax.block_until_ready(token)  # mx-lint: allow(lock-host-sync)
                 except Exception:                          # noqa: BLE001
                     pass
             now = time.perf_counter()

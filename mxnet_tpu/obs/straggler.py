@@ -41,6 +41,7 @@ import time
 from typing import Any, Dict, Optional
 
 from .. import config as _config
+from .. import lockcheck as _lockcheck
 from .. import profiler as _profiler
 
 __all__ = ["FitPublisher", "aggregate", "refresh_gauges", "pod_block",
@@ -52,7 +53,7 @@ log = logging.getLogger(__name__)
 # generation's stale windows against fresh ones
 KEY_FMT = "mxobs/g%d/steps/%d"
 
-_block_lock = threading.Lock()
+_block_lock = _lockcheck.Lock(name="obs.straggler.block_lock")
 _last_block: Optional[Dict[str, Any]] = None
 # ranks whose per-rank gauges this process has set: a rank that leaves
 # the pod (death, reshard to a smaller world) must have its gauges

@@ -37,6 +37,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import lockcheck as _lockcheck
+
 __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class",
            "PythonOp", "NumpyOp", "NDArrayOp"]
 
@@ -55,7 +57,7 @@ _PROP_REGISTRY: Dict[str, type] = {}
 # not a pool: the reference serializes custom ops through its own
 # CustomOperator worker the same way, custom-inl.h Push.)
 
-_cb_lock = threading.Lock()
+_cb_lock = _lockcheck.Lock(name="operator.cb_lock")
 _cb_executor: Optional[ThreadPoolExecutor] = None
 _cb_thread_ident: Optional[int] = None
 

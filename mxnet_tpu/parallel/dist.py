@@ -737,8 +737,9 @@ class PodKVServer(object):
     def __init__(self, port: int = 0, host: str = ""):
         import socket
         import threading
+        from .. import lockcheck as _lockcheck
         self._store: Dict[str, str] = {}
-        self._cond = threading.Condition()
+        self._cond = _lockcheck.Condition(name="dist.podkv_cond")
         self._stopped = False
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

@@ -37,6 +37,7 @@ import math
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import lockcheck as _lockcheck
 from .. import profiler as _profiler
 from ..base import MXNetError
 
@@ -88,7 +89,7 @@ class PageLedger:
         self.total_pages = self.max_slots * (self.max_seq // self.page)
         self._free: List[int] = list(range(self.max_slots - 1, -1, -1))
         self._len: Dict[int, int] = {}      # resident slot -> seq length
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.Lock(name="serve.kv_cache_lock")
 
     def _pages(self, length: int) -> int:
         return max(1, math.ceil(length / self.page))

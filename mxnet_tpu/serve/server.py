@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import lockcheck as _lockcheck
 from .. import ndarray as nd_mod
 from .. import profiler as _profiler
 from ..obs import compiles as _obs_compiles
@@ -290,10 +291,14 @@ class InferenceServer:
         # mutate shared executor state (arg_dict -> forward -> outputs),
         # so a kill-switch eager call in a caller thread must never
         # interleave with the worker's batched call or another caller.
-        # Uncontended on the hot batched path (worker-only).
-        self._model_lock = threading.Lock()
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        # Uncontended on the hot batched path (worker-only). allow_sync:
+        # _call_model fetches outputs under it by design (the adapter's
+        # shared executor state is what the lock serializes — see the
+        # mx-lint allow(lock-host-sync) at the call site).
+        self._model_lock = _lockcheck.Lock(name="serve.model_lock",
+                                           allow_sync=True)
+        self._lock = _lockcheck.Lock(name="serve.queue_lock")
+        self._cond = _lockcheck.Condition(self._lock)
         self._queue: collections.deque = collections.deque()
         self._closed = False
         self._batches = 0
@@ -707,7 +712,7 @@ class GenerateHandle:
     """
 
     def __init__(self, on_token: Optional[Callable[[int], None]] = None):
-        self._cond = threading.Condition()
+        self._cond = _lockcheck.Condition(name="serve.stream_cond")
         self._tokens: List[int] = []
         self._done = False
         self._exc: Optional[BaseException] = None
@@ -954,8 +959,8 @@ class GenerativeServer:
         self.metrics_port = self._metrics.port if self._metrics else None
         self._metrics_finalizer = weakref.finalize(
             self, self._metrics.close) if self._metrics else None
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = _lockcheck.Lock(name="serve.gen_lock")
+        self._cond = _lockcheck.Condition(self._lock)
         self._waiting: collections.deque = collections.deque()
         self._active: List[_ActiveSeq] = []
         self._closed = False

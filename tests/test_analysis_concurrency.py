@@ -1,0 +1,507 @@
+"""Whole-program lock-order analysis (mxnet_tpu.analysis.concurrency).
+
+Fires / stays-silent pairs for every finding the pass emits —
+``lock-order-cycle``, interprocedural ``lock-host-sync`` (the PR 2
+train_rcnn deadlock shape: helper-hidden sync under a caller's lock),
+``unlocked-shared-state`` — plus the bare ``acquire()``/``release()``
+lock_stack fix in the lexical linter.
+"""
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu.analysis import lint_paths, lint_source  # noqa: E402
+
+
+def codes(report, code=None):
+    if code is None:
+        return [f.code for f in report]
+    return [f for f in report if f.code == code]
+
+
+def lint_tree(tmp_path, **files):
+    for name, src in files.items():
+        (tmp_path / (name + ".py")).write_text(textwrap.dedent(src))
+    return lint_paths([str(tmp_path)])
+
+
+# ===================================================== lock-order-cycle
+
+
+ABBA_A = """
+    import threading
+    import mod_b
+
+    LA = threading.Lock()
+
+    def fa():
+        with LA:
+            with mod_b.LB:
+                pass
+"""
+
+ABBA_B = """
+    import threading
+    import mod_a
+
+    LB = threading.Lock()
+
+    def fb():
+        with LB:
+            with mod_a.LA:
+                pass
+"""
+
+
+def test_two_module_abba_cycle_fires(tmp_path):
+    """The synthetic two-module ABBA cycle reports an ERROR naming BOTH
+    acquisition chains with file:line (acceptance criterion)."""
+    report = lint_tree(tmp_path, mod_a=ABBA_A, mod_b=ABBA_B)
+    found = codes(report, "lock-order-cycle")
+    assert len(found) == 1, [str(f) for f in report]
+    f = found[0]
+    assert f.severity.name == "ERROR"
+    assert "mod_a.LA" in f.message and "mod_b.LB" in f.message
+    assert "mod_a.py:" in f.message and "mod_b.py:" in f.message
+    # both chains, not just the closing edge
+    assert f.message.count("while holding") >= 2 or \
+        f.message.count("while the caller holds") >= 1
+
+
+def test_consistent_order_stays_silent(tmp_path):
+    """Same two locks, both paths take them in the SAME order: no cycle."""
+    report = lint_tree(
+        tmp_path,
+        mod_a=ABBA_A,
+        mod_b="""
+            import threading
+            import mod_a
+
+            LB = threading.Lock()
+
+            def fb():
+                with mod_a.LA:
+                    with LB:
+                        pass
+        """)
+    assert not codes(report, "lock-order-cycle"), \
+        [str(f) for f in codes(report, "lock-order-cycle")]
+
+
+def test_cycle_through_helper_call_fires(tmp_path):
+    """The interprocedural edge: fa holds LA and CALLS a helper that
+    acquires LB; fb nests them the other way lexically."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            LA = threading.Lock()
+            LB = threading.Lock()
+
+            def helper():
+                with LB:
+                    pass
+
+            def fa():
+                with LA:
+                    helper()
+
+            def fb():
+                with LB:
+                    with LA:
+                        pass
+        """)
+    assert len(codes(report, "lock-order-cycle")) == 1, \
+        [str(f) for f in report]
+
+
+def test_cycle_allow_annotation_suppresses(tmp_path):
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            LA = threading.Lock()
+            LB = threading.Lock()
+
+            def fa():
+                with LA:
+                    with LB:  # mx-lint: allow(lock-order-cycle)
+                        pass
+
+            def fb():
+                with LB:
+                    with LA:
+                        pass
+        """)
+    assert not codes(report, "lock-order-cycle")
+
+
+def test_instance_attr_locks_cycle_fires(tmp_path):
+    """self._*lock* attrs are named nodes too — an ABBA between two
+    methods of one class is a cycle."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            class Srv:
+                def __init__(self):
+                    self._queue_lock = threading.Lock()
+                    self._model_lock = threading.Lock()
+
+                def submit(self):
+                    with self._queue_lock:
+                        with self._model_lock:
+                            pass
+
+                def shutdown(self):
+                    with self._model_lock:
+                        with self._queue_lock:
+                            pass
+        """)
+    found = codes(report, "lock-order-cycle")
+    assert len(found) == 1, [str(f) for f in report]
+    assert "Srv._queue_lock" in found[0].message
+    assert "Srv._model_lock" in found[0].message
+
+
+# ==================================== interprocedural lock-host-sync
+
+
+RCNN_SHAPE = """
+    import threading
+
+    class Trainer:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _fetch(self, x):
+            return x.asnumpy()
+
+        def step(self, x):
+            with self._lock:
+                return self._fetch(x)
+"""
+
+
+def test_helper_hidden_sync_under_lock_fires(tmp_path):
+    """The PR 2 train_rcnn deadlock shape (acceptance criterion): the
+    sync is one call deep, invisible to the lexical linter — the
+    interprocedural pass names caller lock, helper and sync site."""
+    report = lint_tree(tmp_path, trainer=RCNN_SHAPE)
+    found = codes(report, "lock-host-sync")
+    assert len(found) == 1, [str(f) for f in report]
+    f = found[0]
+    assert f.severity.name == "ERROR"
+    assert "_fetch" in f.message and "asnumpy" in f.message
+    assert "Trainer._lock" in f.message
+    assert "trainer.py:" in f.message        # the callee sync site
+
+
+def test_helper_sync_outside_lock_stays_silent(tmp_path):
+    """Same helper called OUTSIDE the lock: nothing to report — and the
+    depth-0 lexical finding is not duplicated by this pass."""
+    report = lint_tree(
+        tmp_path,
+        trainer="""
+            import threading
+
+            class Trainer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _fetch(self, x):
+                    return x.asnumpy()
+
+                def step(self, x):
+                    with self._lock:
+                        n = 1
+                    return self._fetch(x)
+        """)
+    assert not codes(report, "lock-host-sync"), \
+        [str(f) for f in codes(report, "lock-host-sync")]
+
+
+def test_lexical_sync_not_double_reported(tmp_path):
+    """A depth-0 sync under a lock is the LEXICAL linter's finding;
+    the interprocedural pass must not report it a second time."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def step(self, x):
+                    with self._lock:
+                        return x.asnumpy()
+        """)
+    assert len(codes(report, "lock-host-sync")) == 1, \
+        [str(f) for f in codes(report, "lock-host-sync")]
+
+
+def test_interprocedural_sync_allow_on_callee_suppresses(tmp_path):
+    report = lint_tree(
+        tmp_path,
+        trainer="""
+            import threading
+
+            class Trainer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _fetch(self, x):
+                    return x.asnumpy()  # mx-lint: allow(lock-host-sync)
+
+                def step(self, x):
+                    with self._lock:
+                        return self._fetch(x)
+        """)
+    assert not codes(report, "lock-host-sync")
+
+
+def test_cross_module_helper_sync_fires(tmp_path):
+    """The helper lives in ANOTHER module, reached via the import
+    alias — still one level, still found."""
+    report = lint_tree(
+        tmp_path,
+        helpers="""
+            def fetch(x):
+                return x.asnumpy()
+        """,
+        caller="""
+            import threading
+            import helpers
+
+            L = threading.Lock()
+
+            def step(x):
+                with L:
+                    return helpers.fetch(x)
+        """)
+    assert len(codes(report, "lock-host-sync")) == 1, \
+        [str(f) for f in report]
+
+
+# ==================================================== unlocked-shared-state
+
+
+def test_unlocked_shared_state_fires(tmp_path):
+    """An attr written under the lock in one method but bare on the
+    Thread-entry path: the discipline has a hole."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            class Srv:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._served = 0
+                    self._worker = threading.Thread(target=self._loop)
+
+                def submit(self):
+                    with self._lock:
+                        self._served += 1
+
+                def _loop(self):
+                    while True:
+                        self._served += 1
+        """)
+    found = codes(report, "unlocked-shared-state")
+    assert len(found) == 1, [str(f) for f in report]
+    f = found[0]
+    assert f.severity.name == "WARNING"
+    assert "_served" in f.message and "_loop" in f.message
+
+
+def test_locked_everywhere_stays_silent(tmp_path):
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            class Srv:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._served = 0
+                    self._worker = threading.Thread(target=self._loop)
+
+                def submit(self):
+                    with self._lock:
+                        self._served += 1
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._served += 1
+        """)
+    assert not codes(report, "unlocked-shared-state")
+
+
+def test_init_writes_are_exempt(tmp_path):
+    """__init__ runs before Thread.start() — that edge is the
+    happens-before, not a hole."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            class Srv:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._served = 0
+                    self._worker = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    with self._lock:
+                        self._served += 1
+        """)
+    assert not codes(report, "unlocked-shared-state")
+
+
+# =========================================== bare acquire()/release()
+
+
+def test_bare_acquire_sync_fires():
+    """Satellite: the try/finally acquire()/release() idiom must feed
+    lock_stack — a sync between the pair is exactly as deadlock-prone
+    as under `with`."""
+    report = lint_source(textwrap.dedent("""
+        class T:
+            def fetch(self, x):
+                self._lock.acquire()
+                try:
+                    return x.asnumpy()
+                finally:
+                    self._lock.release()
+    """))
+    found = codes(report, "lock-host-sync")
+    assert len(found) == 1, codes(report)
+    assert "_lock" in found[0].message
+
+
+def test_bare_release_ends_tracking():
+    """After release() the lock is no longer held — the sync below the
+    pair stays silent."""
+    report = lint_source(textwrap.dedent("""
+        class T:
+            def fetch(self, x):
+                self._lock.acquire()
+                n = self._n
+                self._lock.release()
+                return x.asnumpy()
+    """))
+    assert not codes(report, "lock-host-sync"), codes(report)
+
+
+def test_bare_acquire_dispatch_warns():
+    report = lint_source(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def f(lock, x):
+            lock.acquire()
+            try:
+                return jnp.sum(x)
+            finally:
+                lock.release()
+    """))
+    assert len(codes(report, "lock-dispatch")) == 1, codes(report)
+
+
+def test_bare_acquire_feeds_concurrency_graph(tmp_path):
+    """acquire()/release() pairs build the SAME order edges as `with` —
+    an ABBA between the two idioms is still a cycle."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            LA = threading.Lock()
+            LB = threading.Lock()
+
+            def fa():
+                LA.acquire()
+                try:
+                    with LB:
+                        pass
+                finally:
+                    LA.release()
+
+            def fb():
+                with LB:
+                    with LA:
+                        pass
+        """)
+    assert len(codes(report, "lock-order-cycle")) == 1, \
+        [str(f) for f in report]
+
+
+# ================================================== shipped-tree shapes
+
+
+def test_condition_aliasing_no_false_cycle(tmp_path):
+    """Condition(self._lock) shares the lock's node — nesting the cond
+    and its own lock must never read as a two-node cycle."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            import threading
+
+            class Srv:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+
+                def wake(self):
+                    with self._lock:
+                        self._cond.notify_all()
+
+                def wait_done(self):
+                    with self._cond:
+                        with self._lock:
+                            pass
+        """)
+    assert not codes(report, "lock-order-cycle"), \
+        [str(f) for f in codes(report, "lock-order-cycle")]
+
+
+def test_lockcheck_funnel_locks_are_named(tmp_path):
+    """Locks created through the mxnet_tpu.lockcheck funnels are
+    first-class nodes, same as raw threading ones."""
+    report = lint_tree(
+        tmp_path,
+        mod="""
+            from mxnet_tpu import lockcheck
+
+            LA = lockcheck.Lock(name="A")
+            LB = lockcheck.Lock(name="B")
+
+            def fa():
+                with LA:
+                    with LB:
+                        pass
+
+            def fb():
+                with LB:
+                    with LA:
+                        pass
+        """)
+    assert len(codes(report, "lock-order-cycle")) == 1, \
+        [str(f) for f in report]
+
+
+def test_findings_flow_through_baseline_keys(tmp_path):
+    """Concurrency findings carry path/func, so the ordinary baseline
+    keying (path::code::func) covers them."""
+    from mxnet_tpu.analysis import baseline_key
+    report = lint_tree(tmp_path, trainer=RCNN_SHAPE)
+    f = codes(report, "lock-host-sync")[0]
+    key = baseline_key(f, str(tmp_path))
+    assert key == "trainer.py::lock-host-sync::Trainer.step", key

@@ -1,0 +1,267 @@
+"""Runtime lock witness (mxnet_tpu.lockcheck / MXNET_TPU_LOCKCHECK).
+
+The dynamic twin of the static lock-order pass: a real two-thread ABBA
+inversion is provoked and must be flagged online (warn counts + logs,
+abort raises BEFORE the blocking acquire), held-lock device syncs are
+caught at the NDArray sync points, and the off path is subprocess-proven
+to never construct the wrapper nor move a ``lockcheck_*`` counter.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx                                     # noqa: E402
+from mxnet_tpu import config, lockcheck, profiler          # noqa: E402
+from mxnet_tpu.base import MXNetError                      # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture()
+def witness_mode(request):
+    mode = getattr(request, "param", "warn")
+    config.set("MXNET_TPU_LOCKCHECK", mode)
+    lockcheck.reset_order_graph()
+    yield mode
+    config.reset("MXNET_TPU_LOCKCHECK")
+    lockcheck.reset_order_graph()
+
+
+def run_in_thread(fn):
+    exc = []
+
+    def body():
+        try:
+            fn()
+        except BaseException as e:                         # noqa: BLE001
+            exc.append(e)
+
+    t = threading.Thread(target=body)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive(), "witness thread hung"
+    return exc
+
+
+# ============================================================ inversion
+
+
+def test_abba_inversion_warn_counts(witness_mode):
+    """Two threads take A->B then B->A sequentially (a REAL inversion
+    shape, observable without actually deadlocking): warn mode counts
+    lockcheck_inversion exactly once for the pair."""
+    a = lockcheck.Lock(name="A")
+    b = lockcheck.Lock(name="B")
+    with profiler.counter_delta() as d:
+        run_in_thread(lambda: _nest(a, b))
+        run_in_thread(lambda: _nest(b, a))
+        assert d.get("lockcheck_inversion") == 1, d.all()
+        # the pair is flagged once, not once per re-observation
+        run_in_thread(lambda: _nest(b, a))
+        assert d.get("lockcheck_inversion") == 1, d.all()
+
+
+def _nest(outer, inner):
+    with outer:
+        with inner:
+            pass
+
+
+@pytest.mark.parametrize("witness_mode", ["abort"], indirect=True)
+def test_abba_inversion_abort_raises(witness_mode):
+    """Abort mode raises MXNetError in the inverting thread BEFORE its
+    blocking acquire — the thread stops at the inversion, not inside
+    the deadlock it would have caused."""
+    a = lockcheck.Lock(name="A")
+    b = lockcheck.Lock(name="B")
+    with profiler.counter_delta() as d:
+        assert run_in_thread(lambda: _nest(a, b)) == []
+        exc = run_in_thread(lambda: _nest(b, a))
+        assert len(exc) == 1 and isinstance(exc[0], MXNetError), exc
+        assert "inversion" in str(exc[0])
+        # both chains with sites are in the message
+        assert "while holding lock[B]" in str(exc[0])
+        assert "while holding lock[A]" in str(exc[0])
+        assert d.get("lockcheck_inversion") == 1, d.all()
+
+
+def test_consistent_order_is_silent(witness_mode):
+    a = lockcheck.Lock(name="A")
+    b = lockcheck.Lock(name="B")
+    with profiler.counter_delta() as d:
+        for _ in range(3):
+            run_in_thread(lambda: _nest(a, b))
+        assert d.get("lockcheck_inversion") == 0, d.all()
+
+
+def test_rlock_reentry_is_not_an_inversion(witness_mode):
+    r = lockcheck.RLock(name="R")
+    other = lockcheck.Lock(name="O")
+    with profiler.counter_delta() as d:
+        with r:
+            with other:
+                with r:          # reentrant re-acquire while holding O
+                    pass
+        # ...even though O->R now exists alongside R->O
+        assert d.get("lockcheck_inversion") == 0, d.all()
+
+
+def test_trylock_records_no_edges(witness_mode):
+    """A non-blocking acquire cannot complete a deadlock cycle — an ABBA
+    via try-acquires must not flag."""
+    a = lockcheck.Lock(name="A")
+    b = lockcheck.Lock(name="B")
+
+    def t1():
+        with a:
+            assert b.acquire(False)
+            b.release()
+
+    def t2():
+        with b:
+            assert a.acquire(False)
+            a.release()
+
+    with profiler.counter_delta() as d:
+        run_in_thread(t1)
+        run_in_thread(t2)
+        assert d.get("lockcheck_inversion") == 0, d.all()
+
+
+def test_condition_wait_notify_through_funnel(witness_mode):
+    """Condition round-trip: wait() releases ALL recursion levels and
+    the re-acquire is witnessed — held-state stays exact (no phantom
+    held locks after the with-block)."""
+    cond = lockcheck.Condition(name="C")
+    done = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=10)
+            done.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = 50
+    while deadline and not t.is_alive():
+        deadline -= 1
+    import time
+    time.sleep(0.2)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=10)
+    assert done == [True]
+    # the waiter's thread-local held list fully drained
+    with profiler.counter_delta() as d:
+        with cond:
+            pass
+        assert d.get("lockcheck_inversion") == 0, d.all()
+
+
+def test_condition_sharing_witnessed_lock(witness_mode):
+    lock = lockcheck.Lock(name="shared")
+    cond = lockcheck.Condition(lock)
+    with cond:
+        cond.notify_all()
+    assert not lock.locked()
+
+
+# ============================================================ held sync
+
+
+def test_held_sync_counts_and_warns(witness_mode):
+    x = mx.nd.array(np.zeros(3))
+    guard = lockcheck.Lock(name="guard")
+    with profiler.counter_delta() as d:
+        with guard:
+            x.asnumpy()
+        assert d.get("lockcheck_held_sync") == 1, d.all()
+        with guard:
+            x.asnumpy()          # same (site, sync) pair: once
+        assert d.get("lockcheck_held_sync") == 1, d.all()
+
+
+def test_allow_sync_lock_is_exempt(witness_mode):
+    """allow_sync=True is the runtime twin of the static
+    allow(lock-host-sync) justification (serve's _model_lock)."""
+    x = mx.nd.array(np.zeros(3))
+    ok = lockcheck.Lock(name="justified", allow_sync=True)
+    with profiler.counter_delta() as d:
+        with ok:
+            x.asnumpy()
+            x.wait_to_read()
+        assert d.get("lockcheck_held_sync") == 0, d.all()
+
+
+@pytest.mark.parametrize("witness_mode", ["abort"], indirect=True)
+def test_held_sync_abort_raises(witness_mode):
+    x = mx.nd.array(np.zeros(3))
+    guard = lockcheck.Lock(name="guard2")
+    with pytest.raises(MXNetError, match="host sync"):
+        with guard:
+            x.asnumpy()
+
+
+def test_unlocked_sync_is_silent(witness_mode):
+    x = mx.nd.array(np.zeros(3))
+    with profiler.counter_delta() as d:
+        x.asnumpy()
+        x.wait_to_read()
+        assert d.get("lockcheck_held_sync") == 0, d.all()
+
+
+# ============================================================= zero cost
+
+
+def test_lockcheck_off_is_zero_cost():
+    """Knob off (default): the funnels return PLAIN threading
+    primitives (no wrapper object anywhere), serve traffic moves no
+    lockcheck_* counter, and exercising sync points records nothing —
+    subprocess-proven like every other knob (satellite + CI gate)."""
+    prog = textwrap.dedent("""
+        import sys, threading
+        sys.path.insert(0, %r)
+        import numpy as np
+        import mxnet_tpu as mx
+        from mxnet_tpu import lockcheck, profiler
+
+        l = lockcheck.Lock(name="x")
+        r = lockcheck.RLock()
+        c = lockcheck.Condition()
+        assert type(l) is type(threading.Lock()), type(l)
+        assert type(r) is type(threading.RLock()), type(r)
+        assert type(c) is threading.Condition, type(c)
+
+        x = mx.nd.array(np.arange(8.0))
+        with l:
+            x.asnumpy()
+            x.wait_to_read()
+        bad = [k for k in profiler.counters() if k.startswith("lockcheck")]
+        assert not bad, bad
+        print("LOCKCHECK_ZERO_COST_OK")
+    """) % (REPO,)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    env.pop("MXNET_TPU_LOCKCHECK", None)
+    res = subprocess.run([sys.executable, "-c", prog], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, res.stderr
+    assert "LOCKCHECK_ZERO_COST_OK" in res.stdout
+
+
+def test_mode_flip_affects_new_locks(witness_mode):
+    """The knob is read at lock creation: flipping it off leaves already
+    -witnessed locks witnessed but new locks plain."""
+    assert lockcheck.mode() == "warn"
+    config.set("MXNET_TPU_LOCKCHECK", "off")
+    try:
+        plain = lockcheck.Lock()
+        assert type(plain) is type(threading.Lock())
+    finally:
+        config.set("MXNET_TPU_LOCKCHECK", "warn")
