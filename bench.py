@@ -176,6 +176,22 @@ def _obs_crosscheck():
     }
 
 
+def _tune_provenance():
+    """Where this section's config came from (ISSUE 19): ``tuned`` is
+    True when an autotuner winner was applied in this process
+    (``tune_applied`` counter — fit(tune=...) or MXNET_TPU_TUNE), and
+    ``tune_knobs`` is the knob dict actually in effect either way, so a
+    tuner-vs-hand-tuned bench delta is attributable to specific knobs
+    rather than 'the tuner ran'."""
+    import mxnet_tpu as mx
+    return {
+        "tuned": bool(mx.profiler.counters().get("tune_applied")),
+        "tune_knobs": {k: mx.config.get(k) for k in (
+            "MXNET_TPU_REMAT", "MXNET_TPU_SCAN_LAYERS",
+            "MXNET_TPU_GROUP_UPDATE", "MXNET_TPU_ASYNC_WINDOW")},
+    }
+
+
 def section_transformer():
     """Transformer-LM fused train step: tokens/s + MFU on one chip, and
     the deep-model compile-time delta: bind + first-step wall with
@@ -257,6 +273,7 @@ def section_transformer():
     rec.update({"transformer_tok_s": round(tok_s, 1),
                 "transformer_mfu": mfu})
     rec.update(_obs_crosscheck())
+    rec.update(_tune_provenance())
 
     # the unrolled control arm LAST (it is the wedge-prone one — round 5
     # died in exactly this bind); its guard exit keeps everything above
@@ -374,6 +391,7 @@ def _resnet_run(rec, batch, iters, grad_accum=None, remat=None,
         "accum_steps": counters.get("accum_steps", 0),
     })
     rec.update(_obs_crosscheck())
+    rec.update(_tune_provenance())
     return rec
 
 
@@ -457,9 +475,11 @@ def _merge(records):
         "first_step_secs": {},
         "obs_mfu": {},
         "obs_bind_ms_total": {},
+        "tuned": {},
+        "tune_knobs": {},
     }
     _per_section = ("bind_secs", "first_step_secs", "obs_mfu",
-                    "obs_bind_ms_total")
+                    "obs_bind_ms_total", "tuned", "tune_knobs")
     errors = {}
     for name, rec in records.items():
         if "error" in rec and not any(

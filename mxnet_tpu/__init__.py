@@ -75,7 +75,10 @@ def __getattr__(name):
     # multiprocessing machinery (tools/data_smoke.py zero-cost gate).
     # importlib, NOT `from . import analysis`: the fromlist form re-enters
     # this __getattr__ via importlib._handle_fromlist -> infinite recursion
-    if name in ("analysis", "checkpoint", "data", "elastic", "faults"):
+    # tune likewise: MXNET_TPU_TUNE unset must mean the tuner is never
+    # imported (tools/tune_smoke.py zero-cost gate)
+    if name in ("analysis", "checkpoint", "data", "elastic", "faults",
+                "tune"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -64,6 +64,7 @@ from .lint import (baseline_key, diff_baseline, lint_paths, lint_source,
                    load_baseline, stale_baseline, write_baseline)
 from .concurrency import analyze_sources
 from . import concurrency, memory_passes, roofline, sharding_passes
+from . import tuning
 from .sharding_passes import (analyze_collectives, analyze_module_sharding,
                               check_islands, check_replicated, check_specs)
 
@@ -74,6 +75,7 @@ __all__ = [
     "analyze_collectives", "analyze_module_sharding",
     "check_specs", "check_islands", "check_replicated",
     "memory_passes", "sharding_passes", "roofline", "concurrency",
+    "tuning",
     "lint_paths", "lint_source", "analyze_sources",
     "load_baseline", "write_baseline", "diff_baseline", "stale_baseline",
     "baseline_key",

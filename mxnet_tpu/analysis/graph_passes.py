@@ -82,7 +82,8 @@ class GraphContext:
     variable shapes/dtypes, and per-entry output shapes from the node-wise
     abstract evaluation (filled by the shape pass, read by the cost pass)."""
 
-    def __init__(self, sym, input_shapes=None, input_dtypes=None):
+    def __init__(self, sym, input_shapes=None, input_dtypes=None,
+                 grad_accum=None, batch_inputs=None):
         from ..symbol.symbol import _topo_order
         self.sym = sym
         self.entries = list(sym._entries)
@@ -90,6 +91,11 @@ class GraphContext:
                              (input_shapes or {}).items()}
         self.input_dtypes = {k: np.dtype(v) for k, v in
                              (input_dtypes or {}).items()}
+        # microbatch accumulation factor + the inputs carrying the batch
+        # axis (data/label names): the cost model's liveness sweep prices
+        # the lax.scan microbatch peak, not the full batch
+        self.grad_accum = max(1, int(grad_accum or 1))
+        self.batch_inputs = frozenset(batch_inputs or ())
         self.has_cycle = False
         self.nodes = _topo_order(self.entries)
         self.arg_names = sym.list_arguments()
@@ -387,7 +393,14 @@ def _node_flops(node, in_avals, out_avals) -> int:
 def cost_model(ctx: GraphContext, report: Report) -> None:
     """Static per-node FLOPs/bytes + liveness memory high-water. Runs only
     over nodes the shape pass resolved; partial graphs yield partial (but
-    still useful) totals, flagged in the summary."""
+    still useful) totals, flagged in the summary.
+
+    With ``ctx.grad_accum = N > 1`` the liveness sweep prices what the
+    fused step actually materializes: one ``lax.scan`` iteration holds a
+    1/N microbatch slice of every batch-leading activation, plus a
+    full-precision gradient carry (one buffer per grad-bearing param)
+    alive across the whole scan. FLOPs and bytes_moved stay full-batch —
+    the scan runs all N microbatches per step."""
     if ctx.has_cycle:
         return
     # every bound variable buffer (params AND data/label inputs): this is
@@ -397,6 +410,62 @@ def cost_model(ctx: GraphContext, report: Report) -> None:
         if node.is_variable and (id(node), 0) in ctx.shapes:
             s, dt = ctx.shapes[(id(node), 0)]
             bound_bytes += _nelem(s) * dt.itemsize
+
+    # microbatching: resolve the batch axis from the declared batch
+    # inputs; scaling applies only when every batch input agrees and N
+    # divides it (exactly the fused step's own set_grad_accum contract)
+    accum = ctx.grad_accum
+    batch = None
+    if accum > 1 and ctx.batch_inputs:
+        leads = set()
+        for node in ctx.nodes:
+            if node.is_variable and node.name in ctx.batch_inputs:
+                aval = ctx.shapes.get((id(node), 0))
+                if aval and aval[0]:
+                    leads.add(int(aval[0][0]))
+        if len(leads) == 1:
+            b = leads.pop()
+            if b % accum == 0:
+                batch = b
+
+    # batch-tainted nodes: everything dataflow-reachable from a batch
+    # input. A tainted activation whose element count divides by the
+    # batch carries the batch axis SOMEWHERE — leading ((N,T,D)), folded
+    # into the lead by reshape ((N*T, D)), or moved inward by transpose
+    # ((3, N, H, T, d)) — and shrinks by 1/N inside the scan body.
+    # Weight-only intermediates with coincidentally-divisible sizes must
+    # NOT shrink (scan-invariant), which is what the taint gate is for;
+    # the residue this rule mis-prices is batch REDUCTIONS (tainted,
+    # batch axis summed away, size still divisible by luck) — small by
+    # construction, and an underestimate only of the scaled-down term.
+    tainted = set()
+    if batch is not None:
+        for node in ctx.nodes:
+            if node.is_variable:
+                if node.name in ctx.batch_inputs:
+                    tainted.add(id(node))
+            elif any(id(src) in tainted for src, _ in node.inputs):
+                tainted.add(id(node))
+
+    def _live_bytes(node_id, aval) -> int:
+        shape, dt = aval
+        n = _nelem(shape)
+        full = n * dt.itemsize
+        if batch is not None and node_id in tainted and n \
+                and n % batch == 0:
+            return full // accum
+        return full
+
+    # the scan's gradient carry: one f32-width accumulator per
+    # grad-bearing parameter, live for the whole step
+    grad_carry_bytes = 0
+    if batch is not None:
+        skip = ctx.batch_inputs | frozenset(ctx.aux_names)
+        for node in ctx.nodes:
+            if node.is_variable and node.name not in skip:
+                aval = ctx.shapes.get((id(node), 0))
+                if aval is not None:
+                    grad_carry_bytes += _nelem(aval[0]) * aval[1].itemsize
 
     # last topo index consuming each entry; heads live to the end
     order = {id(n): i for i, n in enumerate(ctx.nodes)}
@@ -414,6 +483,11 @@ def cost_model(ctx: GraphContext, report: Report) -> None:
     peak = 0
     skipped = 0
     per_node = []
+    # live-set snapshot at the high-water (the graph twin of
+    # analyze_program_memory's top_live): what the peak is MADE of —
+    # which is what the tuner's remat/accum decisions need to see
+    live_entries: Dict[Tuple[int, int], Tuple[str, int]] = {}
+    peak_live: List[Tuple[str, int]] = []
     for idx, node in enumerate(ctx.nodes):
         if node.is_variable:
             continue
@@ -440,9 +514,17 @@ def cost_model(ctx: GraphContext, report: Report) -> None:
         total_bytes += in_b + out_b
         per_node.append((node.name, node.op.name, flops, in_b + out_b))
         # liveness: outputs materialize, then inputs whose last use is
-        # this node die (variables/params are counted separately above)
-        live += out_b
-        peak = max(peak, live)
+        # this node die (variables/params are counted separately above);
+        # under grad_accum only a microbatch slice of each batch-leading
+        # activation is live inside the scan body
+        for a_i, a in enumerate(out_avals):
+            b = _live_bytes(id(node), a)
+            live += b
+            live_entries[(id(node), a_i)] = (node.name, b)
+        if live > peak:
+            peak = live
+            peak_live = sorted(live_entries.values(),
+                               key=lambda t: -t[1])[:10]
         # each dying entry frees ONCE even when consumed through several
         # edges of this node (x*x, concat(x, x))
         dying = {(id(src), i) for src, i in node.inputs
@@ -451,19 +533,24 @@ def cost_model(ctx: GraphContext, report: Report) -> None:
         for key in dying:
             aval = ctx.shapes.get(key)
             if aval is not None:
-                live -= _nelem(aval[0]) * aval[1].itemsize
+                live -= _live_bytes(key[0], aval)
+                live_entries.pop(key, None)
 
     per_node.sort(key=lambda r: -r[2])
+    act_peak = peak + grad_carry_bytes
     cost = {
         "flops": total_flops,
         "bytes_moved": total_bytes,
         "bound_bytes": bound_bytes,
-        "peak_bytes": bound_bytes + peak,
-        "activation_peak_bytes": peak,
+        "peak_bytes": bound_bytes + act_peak,
+        "activation_peak_bytes": act_peak,
+        "grad_accum": accum,
+        "grad_carry_bytes": grad_carry_bytes,
         "nodes_skipped": skipped,
         "top_nodes": [
             {"node": n, "op": o, "flops": f, "bytes": b}
             for n, o, f, b in per_node[:10]],
+        "peak_live": [{"node": n, "bytes": b} for n, b in peak_live],
     }
     report.extras["cost"] = cost
     report.add(
@@ -484,7 +571,8 @@ GRAPH_PASSES.append(("cost-model", cost_model))
 
 def analyze_symbol(sym, input_shapes=None, input_dtypes=None,
                    passes=None, context: str = "graph",
-                   calibrate_remat=None) -> Report:
+                   calibrate_remat=None, grad_accum=None,
+                   batch_inputs=None) -> Report:
     """Run the graph passes over ``sym``; returns a :class:`Report`.
 
     ``input_shapes``/``input_dtypes`` play the role of bind-time shapes
@@ -495,9 +583,13 @@ def analyze_symbol(sym, input_shapes=None, input_dtypes=None,
     calibration; None (default) runs it only when an applied-remat knob
     is active — a plain warn/strict bind analysis must stay
     execution-free (memory_passes._predict_block_savings).
+    ``grad_accum=N`` with ``batch_inputs`` (the data/label variable
+    names) makes the cost model price the microbatch scan peak instead
+    of the full batch — see :func:`cost_model`.
     """
     report = Report(context=context)
-    ctx = GraphContext(sym, input_shapes, input_dtypes)
+    ctx = GraphContext(sym, input_shapes, input_dtypes,
+                       grad_accum=grad_accum, batch_inputs=batch_inputs)
     ctx.calibrate_remat = calibrate_remat
     for code, fn in GRAPH_PASSES:
         if passes is not None and code not in passes:

@@ -50,6 +50,7 @@ from . import profiler as _profiler
 __all__ = [
     "enabled", "supported", "fingerprint", "digest", "load", "store",
     "load_or_compile", "install_persistent_cache_fence",
+    "config_store_dir",
 ]
 
 log = logging.getLogger(__name__)
@@ -63,6 +64,17 @@ def enabled() -> Optional[str]:
     from . import config as _config
     d = _config.get("MXNET_TPU_COMPILE_CACHE")
     return d or None
+
+
+def config_store_dir() -> Optional[str]:
+    """Directory for persisted ``TunedConfig`` records (mxnet_tpu.tune):
+    ``MXNET_TPU_TUNE_STORE`` when set, else co-located with the AOT
+    executable cache — a restarted ``fit(tune="auto")`` finds the tuned
+    knobs next to the executables they compile into, keyed by the same
+    :func:`digest` fingerprint scheme. None = no persistence."""
+    from . import config as _config
+    d = _config.get("MXNET_TPU_TUNE_STORE")
+    return d or enabled()
 
 
 # knobs ops read at TRACE time: their value is baked into the compiled

@@ -417,6 +417,41 @@ register("MXNET_TPU_COMPILE_CACHE", str, "",
          "executables mis-execute on this jax version — the fence is "
          "capability-probed, see docs/architecture/program_model.md). "
          "Empty = off")
+def _parse_tune(v) -> str:
+    s = str(v).strip().lower()
+    if s in ("", "0", "off", "false", "no", "none"):
+        return "off"
+    if s in ("auto", "on", "true", "yes", "1"):
+        return "auto"
+    if s == "static":
+        return "static"
+    raise ValueError(
+        "MXNET_TPU_TUNE must be off|auto|static, got %r" % (v,))
+
+
+register("MXNET_TPU_TUNE", _parse_tune, "off",
+         "fit(): self-tuning performance search (mxnet_tpu.tune) — "
+         "auto = load the stored TunedConfig for this program "
+         "fingerprint or run the full static-prune + probe search and "
+         "apply the winner's knobs before bind; static = static "
+         "pruning/ranking only, no probe subprocesses (deterministic); "
+         "off = the tune package is never imported (zero cost)")
+register("MXNET_TPU_TUNE_PROBE_SECS", float, 120.0,
+         "tune.search: per-probe subprocess deadline in seconds "
+         "(PhaseGuard discipline — a timed-out probe is scored failed "
+         "and the search keeps its partial results)")
+register("MXNET_TPU_TUNE_PROBE_STEPS", int, 8,
+         "tune.search: measured steps per probe run (after the 2 "
+         "obs-warmup steps that absorb the compile)")
+register("MXNET_TPU_TUNE_MAX_PROBES", int, 4,
+         "tune.search: empirical probe budget — statically-ranked "
+         "candidates probed per search (the default config is always "
+         "probed in addition); 0 = static-only ranking")
+register("MXNET_TPU_TUNE_STORE", str, "",
+         "tune: TunedConfig store directory; empty = co-locate with "
+         "MXNET_TPU_COMPILE_CACHE (the aot executable cache), so a "
+         "restart finds the tuned knobs next to the executables they "
+         "compile into. Both empty = no persistence")
 register("MXNET_TPU_LAYERNORM_TWO_PASS", _parse_bool, False,
          "LayerNorm: two-pass E[(x-mean)^2] variance instead of the fused "
          "one-pass E[x^2]-E[x]^2 form — restores precision for "

@@ -261,7 +261,7 @@ class BaseModule(object):
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint=None, resume_from=None,
-            grad_accum=None, layout=None):
+            grad_accum=None, layout=None, tune=None):
         """Train the module (reference: base_module.py:376 — the canonical
         forward_backward → update → update_metric loop with epoch/batch
         callbacks and checkpointing hooks).
@@ -313,6 +313,17 @@ class BaseModule(object):
         ``resume_from=`` (reshard-on-load resolves through the same
         layout funnel). Requires a module implementing ``set_layout``
         (mx.mod.Module).
+
+        ``tune="auto"`` (docs/architecture/tune.md): before binding,
+        load or search the tuned configuration for this program
+        (``mxnet_tpu.tune``) and apply it — remat / scan / group-update
+        / async-window via config overrides, ``grad_accum`` and
+        ``layout`` through these same arguments when the caller left
+        them None (explicit arguments win). ``"static"`` skips probe
+        subprocesses (model-only pick); default follows the
+        ``MXNET_TPU_TUNE`` knob. With a stored config and a warm AOT
+        compile cache a restarted fit reaches its first step pre-tuned
+        with zero search cost and zero backend compiles.
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
@@ -360,6 +371,39 @@ class BaseModule(object):
                              resume.path, resume.step, begin_epoch,
                              ", batch %d" % resume.batches_done
                              if resume.mid_epoch else "")
+
+        # ------------------------------------------------------------ tune
+        # fit(tune="auto"): search (or load) the tuned configuration for
+        # this exact program and apply it before anything binds. The knob
+        # winners flow through mx.config overrides; grad_accum/layout go
+        # through fit's own arguments — but ONLY when the caller left
+        # them None (explicit user arguments always win). With a stored
+        # config and a warm AOT cache this path costs one JSON read:
+        # pre-tuned AND pre-compiled (docs/architecture/tune.md).
+        tune_mode = tune if tune is not None \
+            else _config.get("MXNET_TPU_TUNE")
+        if tune_mode in (True, 1, "on", "1", "yes", "true"):
+            tune_mode = "auto"
+        if tune_mode not in (None, False, 0, "", "off", "0", "no",
+                             "false", "none"):
+            from .. import tune as _tune   # lazy: only when armed
+            budget = _config.get("MXNET_TPU_ANALYZE_HBM_BUDGET") or None
+            tuned = _tune.tune_fit(self, train_data, optimizer,
+                                   optimizer_params, mode=str(tune_mode),
+                                   budget=budget)
+            cand = tuned.candidate
+            for knob, val in cand.knobs().items():
+                _config.set(knob, val)
+            if grad_accum is None and cand.grad_accum > 1:
+                grad_accum = cand.grad_accum
+            if layout is None and cand.layout is not None:
+                from ..parallel.layout import SpecLayout
+                layout = SpecLayout(data=cand.layout[0],
+                                    fsdp=cand.layout[1],
+                                    tp=cand.layout[2])
+            _profiler.incr_counter("tune_applied")
+            self.logger.info("fit(tune=%s): applying %s config %s",
+                             tune_mode, tuned.source, cand.to_dict())
 
         if layout is not None:
             lay_setter = getattr(self, "set_layout", None)
@@ -470,6 +514,7 @@ class BaseModule(object):
                 ff(begin_epoch, 0, cursor=resume.data_cursor)
 
         wrapped = None
+        placer_sink = None
         inner_train_data = train_data
         if window > 0:
             depth = int(_config.get("MXNET_TPU_DEVICE_PREFETCH"))
@@ -477,15 +522,27 @@ class BaseModule(object):
             if depth > 0 and placer is not None \
                     and hasattr(train_data, "next") \
                     and getattr(train_data, "provide_data", None):
-                from ..io.io import PrefetchingIter
-                if not isinstance(train_data, PrefetchingIter):
-                    train_data = wrapped = PrefetchingIter(
-                        train_data, device_placer=placer,
-                        device_prefetch=depth)
-                # an iterator the user already wrapped is used as-is:
-                # stacking a second PrefetchingIter would add a worker
-                # thread and a queue hop just for the placement stage —
-                # those batches are placed in _load_batch instead
+                sink = getattr(train_data, "_mx_set_device_placer", None)
+                if sink is not None:
+                    # a placement-capable loader (mx.data.DataLoader) IS
+                    # the prefetch stage: its delivered batches already
+                    # carry device arrays (per-host device_put onto the
+                    # mesh data axis, async H2D) — wrapping it in a
+                    # PrefetchingIter would re-copy every batch through
+                    # an extra worker thread + queue hop
+                    sink(placer)
+                    placer_sink = train_data
+                else:
+                    from ..io.io import PrefetchingIter
+                    if not isinstance(train_data, PrefetchingIter):
+                        train_data = wrapped = PrefetchingIter(
+                            train_data, device_placer=placer,
+                            device_prefetch=depth)
+                    # an iterator the user already wrapped is used
+                    # as-is: stacking a second PrefetchingIter would add
+                    # a worker thread and a queue hop just for the
+                    # placement stage — those batches are placed in
+                    # _load_batch instead
 
         # the data-plane cursor source for checkpoint manifests; called
         # with fit's CONSUMED count (nbatch) — the loader's own
@@ -795,6 +852,11 @@ class BaseModule(object):
         finally:
             if uninstall_sigterm is not None:
                 uninstall_sigterm()
+            if placer_sink is not None:
+                # detach so a later fit of the same loader against a
+                # different module (or no module) never places onto a
+                # dead mesh
+                placer_sink._mx_set_device_placer(None)
             if wrapped is not None:
                 joined = wrapped.close()
                 # leave the user's iterator exactly as the synchronous

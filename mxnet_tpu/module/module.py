@@ -493,7 +493,10 @@ class Module(BaseModule):
                            for n, a in self._exec.aux_dict.items()})
         report = analyze_symbol(self._symbol, input_shapes=shapes or None,
                                 input_dtypes=input_dtypes,
-                                context="module")
+                                context="module",
+                                grad_accum=getattr(self, "_grad_accum", 1),
+                                batch_inputs=list(self._data_names)
+                                + list(self._label_names))
         if sharding and self.binded and self._mesh is not None:
             from ..analysis import analyze_module_sharding
             report.extend(analyze_module_sharding(

@@ -53,7 +53,7 @@ __all__ = [
     "render_prometheus", "parse_prometheus", "pod_labels",
     "mfu", "peak_flops", "register_executor",
     "MetricsServer", "start_metrics_server",
-    "report", "blackbox", "straggler",
+    "report", "probe_score", "blackbox", "straggler",
 ]
 
 
@@ -128,3 +128,28 @@ def report() -> Dict[str, Any]:
         if block is not None:
             out["pod"] = block
     return out
+
+
+def probe_score() -> Dict[str, Any]:
+    """Close the current utilization window and return the compact
+    verdict a tuner probe is scored by (:mod:`mxnet_tpu.tune`): the
+    busiest executor's ``steps_per_sec``/``mfu``/``flops_per_sec``, the
+    pod throughput block when a pod is live, and ``loop_recompile`` —
+    the disqualifier (a config that thrashes the executable cache can
+    never win a probe). Call once after warmup to open the window
+    (``report()`` works too) and once after the measured region."""
+    rep = report()
+    best = None
+    for rec in rep["executors"]:
+        if rec.get("steps_per_sec") and (
+                best is None
+                or rec["steps_per_sec"] > best["steps_per_sec"]):
+            best = rec
+    return {
+        "steps_per_sec": best["steps_per_sec"] if best else None,
+        "mfu": best.get("mfu") if best else None,
+        "flops_per_sec": best.get("flops_per_sec") if best else None,
+        "pod": rep.get("pod"),
+        "loop_recompile": int(
+            _profiler.counters().get("loop_recompile", 0)),
+    }

@@ -83,10 +83,13 @@ def bench_scaling(rec, idx, worker_counts, epochs):
 
 def bench_steady_state_fit(rec, idx, workers):
     """A real fit over an UNSTALLED stream — the steady state, where
-    decode keeps up with the step: assert zero loader stalls and zero
-    steady-state recompiles. (The stalled scaling pipeline above is
-    decode-bound by construction; its bubbles are the measurement, not
-    a regression.)"""
+    decode keeps up with the step: assert zero loader stalls, zero
+    steady-state recompiles, zero per-batch host syncs, and that the
+    batches flowed through the loader's own device-placement stage
+    (``data_device_placed`` — the direct device_put path that replaced
+    the PrefetchingIter wrapper's extra host copy). (The stalled scaling
+    pipeline above is decode-bound by construction; its bubbles are the
+    measurement, not a regression.)"""
     import mxnet_tpu as mx
     from mxnet_tpu import profiler
 
@@ -105,18 +108,24 @@ def bench_steady_state_fit(rec, idx, workers):
     mod.fit(dl, num_epoch=1, optimizer="sgd",
             optimizer_params={"learning_rate": 0.05})
     compiles0 = profiler.get_counter("loop_recompile")
+    syncs0 = profiler.get_counter("loop_host_sync")
+    placed0 = profiler.get_counter("data_device_placed")
     t0 = time.perf_counter()
     mod.fit(dl, num_epoch=2, optimizer="sgd",
             optimizer_params={"learning_rate": 0.05})
     wall = time.perf_counter() - t0
     stalls = profiler.get_counter("data_stall") - stall0
     recompiles = profiler.get_counter("loop_recompile") - compiles0
+    host_syncs = profiler.get_counter("loop_host_sync") - syncs0
+    placed = profiler.get_counter("data_device_placed") - placed0
     batches = profiler.get_counter("data_batches")
     dl.close()
     return {"workers": workers, "fit_wall_s": round(wall, 3),
             "batches_delivered": batches,
             "steady_state_stalls": stalls,
-            "steady_state_recompiles": recompiles}
+            "steady_state_recompiles": recompiles,
+            "steady_state_host_syncs": host_syncs,
+            "device_placed": placed}
 
 
 def main():
@@ -143,9 +152,12 @@ def main():
           % speedup_4v1)
 
     steady = bench_steady_state_fit(rec, idx, workers=4)
-    print("steady-state fit: %d stalls, %d recompiles"
+    print("steady-state fit: %d stalls, %d recompiles, %d host syncs, "
+          "%d batches device-placed by the loader"
           % (steady["steady_state_stalls"],
-             steady["steady_state_recompiles"]))
+             steady["steady_state_recompiles"],
+             steady["steady_state_host_syncs"],
+             steady["device_placed"]))
 
     results = {
         "stall_ms_per_record": STALL_S * 1e3,
@@ -168,7 +180,9 @@ def main():
         print("wrote", args.json)
 
     ok = speedup_4v1 >= 1.5 and steady["steady_state_stalls"] == 0 \
-        and steady["steady_state_recompiles"] == 0
+        and steady["steady_state_recompiles"] == 0 \
+        and steady["steady_state_host_syncs"] == 0 \
+        and steady["device_placed"] > 0
     print("GATE:", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 
