@@ -398,7 +398,8 @@ def cost_model(ctx: GraphContext, report: Report) -> None:
     With ``ctx.grad_accum = N > 1`` the liveness sweep prices what the
     fused step actually materializes: one ``lax.scan`` iteration holds a
     1/N microbatch slice of every batch-leading activation, plus a
-    full-precision gradient carry (one buffer per grad-bearing param)
+    gradient carry (one buffer per grad-bearing param, in the param's
+    own dtype — the fused step seeds it with ``zeros_like(param)``)
     alive across the whole scan. FLOPs and bytes_moved stay full-batch —
     the scan runs all N microbatches per step."""
     if ctx.has_cycle:
@@ -456,8 +457,10 @@ def cost_model(ctx: GraphContext, report: Report) -> None:
             return full // accum
         return full
 
-    # the scan's gradient carry: one f32-width accumulator per
-    # grad-bearing parameter, live for the whole step
+    # the scan's gradient carry: one accumulator per grad-bearing
+    # parameter, live for the whole step, priced at the param's own
+    # dtype — the fused step's carry is zeros_like(param), NOT an f32
+    # upcast (module.py micro_step), so the model must not inflate it
     grad_carry_bytes = 0
     if batch is not None:
         skip = ctx.batch_inputs | frozenset(ctx.aux_names)

@@ -483,6 +483,30 @@ def reset(name: str) -> None:
     _notify(name)
 
 
+_NO_OVERRIDE = object()
+
+
+def snapshot_overrides(names) -> Dict[str, Any]:
+    """Capture the runtime-override state of ``names`` for a later
+    :func:`restore_overrides` — the scoped-set discipline callers like
+    ``fit(tune=...)`` use so their knob winners do not outlive the
+    call. A name with no current override is recorded as such (its
+    restore is :func:`reset`, not a re-``set`` of the computed value,
+    so environment changes in between still show through)."""
+    return {str(n): _overrides.get(n, _NO_OVERRIDE) for n in names}
+
+
+def restore_overrides(snapshot: Dict[str, Any]) -> None:
+    """Undo every :func:`set` made since the matching
+    :func:`snapshot_overrides`: re-instate the old override, or drop
+    the knob back to environment/default."""
+    for name, value in snapshot.items():
+        if value is _NO_OVERRIDE:
+            reset(name)
+        else:
+            set(name, value)
+
+
 def describe() -> str:
     """Human-readable table of every knob, its value and source
     (reference: env_var.md as a runtime query)."""

@@ -317,10 +317,11 @@ class BaseModule(object):
         ``tune="auto"`` (docs/architecture/tune.md): before binding,
         load or search the tuned configuration for this program
         (``mxnet_tpu.tune``) and apply it — remat / scan / group-update
-        / async-window via config overrides, ``grad_accum`` and
-        ``layout`` through these same arguments when the caller left
-        them None (explicit arguments win). ``"static"`` skips probe
-        subprocesses (model-only pick); default follows the
+        / async-window via fit-scoped config overrides (restored when
+        fit returns — tuning one fit never reconfigures a later one),
+        ``grad_accum`` and ``layout`` through these same arguments when
+        the caller left them None (explicit arguments win). ``"static"``
+        skips probe subprocesses (model-only pick); default follows the
         ``MXNET_TPU_TUNE`` knob. With a stored config and a warm AOT
         compile cache a restarted fit reaches its first step pre-tuned
         with zero search cost and zero backend compiles.
@@ -384,6 +385,7 @@ class BaseModule(object):
             else _config.get("MXNET_TPU_TUNE")
         if tune_mode in (True, 1, "on", "1", "yes", "true"):
             tune_mode = "auto"
+        tune_knob_snapshot = None
         if tune_mode not in (None, False, 0, "", "off", "0", "no",
                              "false", "none"):
             from .. import tune as _tune   # lazy: only when armed
@@ -392,6 +394,11 @@ class BaseModule(object):
                                    optimizer_params, mode=str(tune_mode),
                                    budget=budget)
             cand = tuned.candidate
+            # the overrides are fit-scoped: snapshot the knobs' override
+            # state now and restore it in the finally below, so a later
+            # fit of a DIFFERENT module with tune off never silently
+            # trains under this winner's configuration
+            tune_knob_snapshot = _config.snapshot_overrides(cand.knobs())
             for knob, val in cand.knobs().items():
                 _config.set(knob, val)
             if grad_accum is None and cand.grad_accum > 1:
@@ -852,6 +859,11 @@ class BaseModule(object):
         finally:
             if uninstall_sigterm is not None:
                 uninstall_sigterm()
+            if tune_knob_snapshot is not None:
+                # drop the tuned knob overrides back to their pre-fit
+                # state (override, environment or default): fit(tune=)
+                # configures THIS fit, not the process
+                _config.restore_overrides(tune_knob_snapshot)
             if placer_sink is not None:
                 # detach so a later fit of the same loader against a
                 # different module (or no module) never places onto a

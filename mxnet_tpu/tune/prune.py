@@ -48,8 +48,9 @@ def static_rank(sym, input_shapes: Dict[str, tuple],
     and the store persists.
 
     Deterministic: one analyzer run per distinct ``grad_accum`` (cached
-    here), a pure score per candidate, and ``Candidate``'s own field
-    order as the final tie-break."""
+    here), a pure score per candidate, and ``Candidate.order_key()``
+    (total-orderable even when layout-None and layout-tuple candidates
+    tie on the score prefix) as the final tie-break."""
     from ..analysis import tuning as _tuning
 
     reports: Dict[int, Any] = {}
@@ -107,14 +108,17 @@ def static_rank(sym, input_shapes: Dict[str, tuple],
             continue
         audit.append({**rec, "fate": "kept"})
         # overhead ordering: comm rank first (layouts), then the cheap
-        # mechanisms; the dataclass order is the deterministic tail
+        # mechanisms; order_key() is the deterministic tail — NOT the
+        # dataclass itself, whose Optional layout makes None-vs-tuple
+        # comparisons raise on a tied prefix (DEFAULT always ties the
+        # top-ranked layout candidate with default knobs)
         score = (comm_rank,
                  0 if cand.remat == "off" else 1,
                  cand.grad_accum,
                  0 if cand.scan_layers == "auto" else 1,
                  0 if cand.group_update else 1,
                  0 if cand.async_window else 1,
-                 cand)
+                 cand.order_key())
         scored.append((score, cand))
     scored.sort(key=lambda t: t[0])
     return [c for _, c in scored], audit

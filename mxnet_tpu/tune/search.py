@@ -140,10 +140,13 @@ def search(sym, data_shapes, label_shapes=None, *,
     # ---- empirical phase: probe the default + the ranked frontier ---
     to_probe: List[Candidate] = []
     if max_probes > 0:
-        # the default is always probed (the winner is >= default by
-        # construction), then the static frontier in rank order
-        to_probe = ([DEFAULT] + [c for c in ranked if c != DEFAULT]
-                    )[:int(max_probes)]
+        # the default is always probed IN ADDITION to the max_probes
+        # budget (the MXNET_TPU_TUNE_MAX_PROBES contract: the winner is
+        # >= default by construction, and even max_probes=1 gives one
+        # ranked candidate an empirical shot), then the static frontier
+        # in rank order
+        to_probe = [DEFAULT] + [c for c in ranked
+                                if c != DEFAULT][:int(max_probes)]
 
     scores: Dict[Candidate, Dict[str, Any]] = {}
     if to_probe:

@@ -18,16 +18,27 @@ __all__ = ["Candidate", "DEFAULT", "enumerate_space", "GRAD_ACCUMS"]
 GRAD_ACCUMS = (1, 2, 4, 8)
 
 
-@dataclass(frozen=True, order=True)
+@dataclass(frozen=True)
 class Candidate:
-    """One point of the knob space. Field order IS the deterministic
-    tie-break order (the dataclass is ``order=True``)."""
+    """One point of the knob space. :meth:`order_key` is the
+    deterministic tie-break (field order, with ``layout=None`` mapped
+    to the empty tuple); the dataclass itself is deliberately NOT
+    ``order=True`` — comparing a ``layout`` of None against a tuple
+    raises TypeError, exactly when candidates tie on a score prefix."""
     remat: str = "off"            # off | auto | a checkpoint-policy name
     grad_accum: int = 1
     scan_layers: str = "auto"     # off | auto
     group_update: bool = True
     async_window: int = 2
     layout: Optional[Tuple[int, int, int]] = None   # (data, fsdp, tp)
+
+    def order_key(self) -> tuple:
+        """Total-orderable deterministic sort tail: field order, the
+        default arm of each knob first, ``layout=None`` below any
+        factorization (None -> ``()``)."""
+        return (self.remat, self.grad_accum, self.scan_layers,
+                not self.group_update, self.async_window,
+                self.layout or ())
 
     def knobs(self) -> Dict[str, Any]:
         """The config-knob dict this candidate applies (grad_accum and

@@ -11,7 +11,8 @@
 * **store**: fingerprint-keyed persistence round-trips; any program
   delta changes the key.
 * **fit(tune=)**: the winner is applied (counter-asserted), explicit
-  user arguments keep precedence.
+  user arguments keep precedence, and the knob overrides are
+  fit-scoped (restored when fit returns).
 * **zero-cost gate**: with ``MXNET_TPU_TUNE`` unset, a full fit never
   imports ``mxnet_tpu.tune`` (subprocess-asserted).
 
@@ -170,6 +171,31 @@ class TestSpaceAndPrune:
                                ["data", "softmax_label"], cands)
         assert kept2 == kept3 and len(kept2) == len(cands)
 
+    def test_static_rank_multi_device_layout_ties(self):
+        """Regression: DEFAULT (layout=None) ties the top-ranked layout
+        candidate with default knobs on the whole score prefix, so the
+        final tie-break must be total-orderable — the old raw-Candidate
+        tail raised TypeError comparing a None layout against a tuple,
+        crashing every multi-device search."""
+        from mxnet_tpu.analysis.tuning import rank_layouts
+        from mxnet_tpu.tune.prune import static_rank
+        from mxnet_tpu.tune.space import DEFAULT, enumerate_space
+        shapes = {"data": (8, 16), "softmax_label": (8, 16)}
+        layout_rank = rank_layouts(8, param_bytes=1 << 20,
+                                   activation_bytes=1 << 18)
+        layouts = [(r["data"], r["fsdp"], r["tp"]) for r in layout_rank]
+        cands = enumerate_space(8, n_devices=8, layouts=layouts)
+        assert DEFAULT in cands
+        kept, audit = static_rank(_tfm(), shapes,
+                                  ["data", "softmax_label"], cands,
+                                  layout_rank=layout_rank)
+        assert len(kept) == len(cands)
+        # the rank is a pure total order: input order cannot change it
+        kept2, _ = static_rank(_tfm(), shapes, ["data", "softmax_label"],
+                               list(reversed(cands)),
+                               layout_rank=layout_rank)
+        assert kept == kept2
+
     def test_rank_layouts_comm_model(self):
         from mxnet_tpu.analysis.tuning import rank_layouts
         recs = rank_layouts(8, param_bytes=1 << 20,
@@ -281,7 +307,9 @@ class TestProbeIsolation:
                      [("softmax_label", (8,))], optimizer="sgd",
                      mode="auto", probe_steps=2, max_probes=1,
                      probe_deadline_s=240, use_store=False)
-        assert cfg.n_probed == 1
+        # max_probes budgets the RANKED candidates; the default is
+        # always probed in addition (the MAX_PROBES help-text contract)
+        assert cfg.n_probed == 2
         after = profiler.counters()
         # the probe's own loop/aot/obs counters must NOT appear here;
         # only the tuner's bookkeeping may move
@@ -328,6 +356,46 @@ class TestFitTune:
         mod.fit(_fit_data(), num_epoch=1, tune="static", grad_accum=2,
                 optimizer_params={"learning_rate": 0.01})
         assert mod._grad_accum == 2
+
+    def test_tuned_knobs_do_not_outlive_fit(self):
+        # the winner's config overrides are fit-scoped: a later fit
+        # with tune off must not inherit them, and a pre-existing user
+        # override must survive the tuned fit untouched
+        from mxnet_tpu import config as _cfg
+        knobs = ("MXNET_TPU_REMAT", "MXNET_TPU_SCAN_LAYERS",
+                 "MXNET_TPU_GROUP_UPDATE", "MXNET_TPU_ASYNC_WINDOW")
+        _cfg.set("MXNET_TPU_REMAT", "off")
+        try:
+            before = _cfg.snapshot_overrides(knobs)
+            mod = mx.mod.Module(_mlp(), context=mx.cpu(0))
+            mod.fit(_fit_data(), num_epoch=1, tune="static",
+                    optimizer_params={"learning_rate": 0.01})
+            assert _cfg.snapshot_overrides(knobs) == before
+        finally:
+            for k in knobs:
+                _cfg.reset(k)
+
+
+def test_config_snapshot_restore_overrides():
+    """The scoped-set primitive fit(tune=) rides: restore re-instates
+    old overrides and DROPS ones that did not exist (back to
+    environment/default, not a frozen copy of the computed value)."""
+    from mxnet_tpu import config as _cfg
+    names = ("MXNET_TPU_REMAT", "MXNET_TPU_ASYNC_WINDOW")
+    _cfg.set("MXNET_TPU_ASYNC_WINDOW", 3)
+    try:
+        snap = _cfg.snapshot_overrides(names)
+        _cfg.set("MXNET_TPU_REMAT", "auto")
+        _cfg.set("MXNET_TPU_ASYNC_WINDOW", 0)
+        _cfg.restore_overrides(snap)
+        assert _cfg.get("MXNET_TPU_ASYNC_WINDOW") == 3
+        # REMAT had no override: restore drops it entirely (back to
+        # environment/default) instead of pinning the computed value
+        assert _cfg.snapshot_overrides(names) == snap
+        assert snap["MXNET_TPU_REMAT"] is _cfg._NO_OVERRIDE
+    finally:
+        for k in names:
+            _cfg.reset(k)
 
 
 # ======================================================= zero-cost gate
