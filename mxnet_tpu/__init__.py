@@ -77,8 +77,11 @@ def __getattr__(name):
     # this __getattr__ via importlib._handle_fromlist -> infinite recursion
     # tune likewise: MXNET_TPU_TUNE unset must mean the tuner is never
     # imported (tools/tune_smoke.py zero-cost gate)
+    # fleet likewise: a plain serve process must never import the
+    # multi-replica gateway or pay its counters (tools/fleet_smoke.py
+    # zero-cost gate)
     if name in ("analysis", "checkpoint", "data", "elastic", "faults",
-                "tune"):
+                "fleet", "tune"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

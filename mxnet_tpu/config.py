@@ -138,6 +138,37 @@ register("MXNET_TPU_SERVE_KV_PAGE", int, 16,
          "capacity is allocated and freed page-at-a-time, and int8 mode "
          "keeps one quantization scale per page. Must divide every "
          "decode bucket")
+register("MXNET_TPU_FLEET", _parse_bool, False,
+         "fleet.Gateway: opt-in switch for the multi-replica serving "
+         "fleet (mxnet_tpu.fleet). Off = the gateway refuses to start "
+         "and the package is never imported by the serve path — "
+         "spawning replica subprocesses is an explicit deployment "
+         "decision, not a framework default")
+register("MXNET_TPU_FLEET_REPLICAS", int, 2,
+         "fleet: default replica-world size when Gateway(replicas=) / "
+         "python -m mxnet_tpu.fleet serve --replicas is not given — "
+         "the env-discovery path for launcher-provisioned worlds")
+register("MXNET_TPU_FLEET_STATS_PERIOD", float, 0.5,
+         "fleet.Gateway: heartbeat cadence in seconds — each tick "
+         "polls every replica's stats() snapshot (queue depth + KV "
+         "occupancy feed least-loaded routing) and doubles as the "
+         "liveness probe (connection REFUSED marks the replica dead; "
+         "a timeout is ambiguous and never kills, the ProbeRing rule)")
+register("MXNET_TPU_FLEET_QUEUE_BOUND", int, 256,
+         "fleet.Gateway: admission bound on gateway-resident in-flight "
+         "requests; beyond it submits shed with QueueFull instead of "
+         "growing an unbounded backlog (same contract as the serve "
+         "queue bound, one level up)")
+register("MXNET_TPU_FLEET_MAX_RESPAWNS", int, 16,
+         "fleet: per-replica supervisor respawn budget — a replica "
+         "that dies more than this many times is marked failed and "
+         "left down (the elastic bounded-restart discipline; backoff "
+         "reuses MXNET_TPU_ELASTIC_BACKOFF/_MAX between attempts)")
+register("MXNET_TPU_FLEET_SPAWN_TIMEOUT", float, 240.0,
+         "fleet: seconds a freshly spawned replica may take to answer "
+         "its first PING (model build + bind + AOT warm start); past "
+         "it the spawn is scored failed and retried under the respawn "
+         "budget (PhaseGuard discipline — no unbounded waits)")
 def _parse_analyze_mode(v) -> str:
     s = str(v).strip().lower()
     if s in ("", "0", "off", "false", "no", "none"):

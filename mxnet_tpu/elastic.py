@@ -106,9 +106,25 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 __all__ = ["Supervisor", "PodCoordinator", "supervise", "resume_dir",
-           "probe_world", "main"]
+           "probe_world", "backoff_delay", "main"]
 
 log = logging.getLogger(__name__)
+
+
+def backoff_delay(restarts: int, backoff: float, backoff_max: float,
+                  rng=None) -> float:
+    """Bounded-exponential respawn delay before the Nth restart
+    (1-based): ``min(backoff_max, backoff * 2**(restarts-1))``, plus up
+    to 25% jitter when ``rng`` (a ``random.Random``) is given. The one
+    formula every supervisor in the tree uses — the training supervisor
+    below and the fleet's per-replica supervisors
+    (``mxnet_tpu.fleet.gateway``) — so a drill can bound worst-case
+    recovery time from the knobs alone."""
+    delay = min(float(backoff_max),
+                float(backoff) * (2 ** (max(1, int(restarts)) - 1)))
+    if rng is not None:
+        delay *= 1.0 + 0.25 * rng.random()
+    return delay
 
 
 def _blackbox():
@@ -353,9 +369,8 @@ class Supervisor(object):
                     return rc if rc != 0 else 1
                 self.restarts += 1
                 _profiler.incr_counter("elastic_restart")
-                delay = min(self.backoff_max,
-                            self.backoff * (2 ** (self.restarts - 1)))
-                delay *= 1.0 + 0.25 * self._rng.random()
+                delay = backoff_delay(self.restarts, self.backoff,
+                                      self.backoff_max, rng=self._rng)
                 log.info("elastic: restart %d/%d in %.2fs",
                          self.restarts, self.max_restarts, delay)
                 self._backoff_sleep(delay)

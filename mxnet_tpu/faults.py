@@ -56,6 +56,17 @@ data.decode          data-plane decode of one      raise
                      batch (poisons THAT batch —
                      data_batch_poisoned — never
                      the epoch)
+replica.die          fleet replica wire, after     sigkill
+                     each emitted token frame
+                     (the gateway re-prefills the
+                     victim's sequences on
+                     survivors, at-most-once
+                     delivery; respawned replicas
+                     do not re-fire)
+gateway.route        fleet gateway, at each        raise
+                     routing decision (kills ONE
+                     request legibly, never the
+                     gateway)
 ===================  ============================  =====================
 
 Failure kinds: ``eio``/``enospc``/``eintr`` raise the matching
@@ -121,6 +132,17 @@ SITES = frozenset((
     #                 poisons THAT batch only (data_batch_poisoned),
     #                 the epoch continues
     "data.worker", "data.decode",
+    # serving fleet (mxnet_tpu.fleet, docs/architecture/serving.md):
+    #   replica.die   — fires in a replica's token-streaming path after
+    #                   the Nth emitted frame, default sigkill: the
+    #                   gateway must detect the corpse, re-prefill the
+    #                   victim's in-flight sequences on survivors and
+    #                   keep token delivery at-most-once (respawned
+    #                   replicas do NOT re-fire this site)
+    #   gateway.route — fires at the gateway's routing decision,
+    #                   default raise: kills exactly ONE request with a
+    #                   legible error, never the gateway
+    "replica.die", "gateway.route",
 ))
 
 # kinds that model a HOST dying rather than one process failing

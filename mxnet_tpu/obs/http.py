@@ -32,7 +32,16 @@ class _Handler(BaseHTTPRequestHandler):
             _mfu.collect()
         except Exception:                                  # noqa: BLE001
             pass    # exposition must render even if a collector dies
-        body = render_prometheus().encode("utf-8")
+        render = getattr(self.server, "render_fn", None) \
+            or render_prometheus
+        try:
+            text = render()
+        except Exception:                                  # noqa: BLE001
+            # a federating renderer (fleet gateway pulling replica
+            # expositions) may fail mid-poll: fall back to this
+            # process's own registry rather than failing the scrape
+            text = render_prometheus()
+        body = text.encode("utf-8")
         self.send_response(200)
         self.send_header("Content-Type",
                          "text/plain; version=0.0.4; charset=utf-8")
@@ -48,9 +57,14 @@ class MetricsServer(object):
     """Daemon-thread /metrics endpoint; ``port=0`` binds an ephemeral
     port (read it back from ``.port``)."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 render=None):
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
+        # optional exposition override: a federating endpoint (the
+        # fleet gateway) renders an AGGREGATED text instead of this
+        # process's registry; None keeps render_prometheus
+        self._httpd.render_fn = render
         self.host = self._httpd.server_address[0]
         self.port = int(self._httpd.server_address[1])
         self._thread = threading.Thread(
@@ -75,10 +89,12 @@ class MetricsServer(object):
         return False
 
 
-def start_metrics_server(port: int = 0,
-                         host: str = "127.0.0.1") -> MetricsServer:
-    """Start (and return) a /metrics endpoint; caller owns ``close()``."""
-    return MetricsServer(port=port, host=host)
+def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
+                         render=None) -> MetricsServer:
+    """Start (and return) a /metrics endpoint; caller owns ``close()``.
+    ``render`` (optional) overrides the exposition text — the fleet
+    gateway passes its replica-aggregating renderer here."""
+    return MetricsServer(port=port, host=host, render=render)
 
 
 def maybe_start_from_knob(explicit: Optional[int] = None) \

@@ -842,10 +842,12 @@ def _gen_loop(server_ref):
         if not busy:
             if closed:
                 return
-            srv = None
+            waiting, active = srv._waiting, srv._active
+            srv = None              # the idle sleep must not pin the server
             with cond:
-                cond.wait(0.05)
-            continue
+                if not waiting and not active:  # re-check under the lock:
+                    cond.wait(0.05)             # a submit in the gap must
+            continue                            # not lose its wakeup
         try:
             srv._iteration()
         except Exception:                                   # noqa: BLE001
@@ -1214,9 +1216,19 @@ class GenerativeServer:
         every waiting AND resident sequence to completion first;
         ``False`` fails waiting requests with :class:`ServerClosed` and
         cancels resident sequences at the next step (their pages free
-        there). Idempotent."""
+        there). Idempotent: a second close only joins — it must not
+        drop requests a prior ``close(drain=True)`` promised to serve.
+
+        Submits racing the close lose cleanly: ``submit_generate``
+        checks ``_closed`` under the same condition variable that sets
+        it here, so a request issued mid-drain raises
+        :class:`ServerClosed` immediately instead of enqueueing behind
+        a scheduler that is about to exit."""
         with self._cond:
+            already = self._closed
             self._closed = True
+            if already:
+                drain = True        # first close's promise stands
             if not drain:
                 dropped = list(self._waiting)
                 self._waiting.clear()
@@ -1228,6 +1240,16 @@ class GenerativeServer:
         for req in dropped:
             req.handle._finish(ServerClosed("server closed"))
         self._worker.join(timeout)
+        if not self._worker.is_alive():
+            # belt-and-braces: if anything slipped into the queue after
+            # the scheduler exited (or the join raced an admit), fail it
+            # legibly — a handle left in a dead server's queue would
+            # hang its caller forever
+            with self._cond:
+                leftover = list(self._waiting)
+                self._waiting.clear()
+            for req in leftover:
+                req.handle._finish(ServerClosed("server closed"))
         if self._metrics_finalizer is not None:
             self._metrics_finalizer()
             self._metrics = None

@@ -1,0 +1,378 @@
+"""mxnet_tpu.fleet — gateway routing, replica supervision, fail-over
+(ISSUE 20 tentpole).
+
+The contract under test: the wire round-trips the serve API (streaming
+tokens + the exception taxonomy) over real sockets; the gateway routes
+least-loaded and keeps sequences sticky; a replica death mid-stream
+fails over with an EXACT at-most-once continuation (the scripted
+decoder's pure-autoregressive token function makes bit-equality
+checkable without a model); shed/deadline/closed propagate as the same
+exception classes a local ``GenerativeServer`` raises; the
+``gateway.route`` fault site kills one request legibly; ``/metrics``
+federates replica-labeled expositions into one parseable text; and the
+package stays zero-cost: a plain ``import mxnet_tpu`` never loads it.
+
+In-process tests front :class:`ScriptedDecodeServer` instances with
+real ``ServeWire`` sockets and run the gateway in ``addresses=`` mode
+(no subprocesses — the supervised-spawn path is exercised by
+``tools/fleet_smoke.py`` with real model replicas). Replica "death"
+here is wire-stop + drain=False close, which exercises both fail-over
+triggers: transport death AND the clean-early-END a gracefully
+shutting-down replica produces.
+"""
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config as _config
+from mxnet_tpu import faults
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import DeadlineExceeded, QueueFull, ServerClosed
+from mxnet_tpu.serve.server import ServeError
+
+
+@pytest.fixture(autouse=True)
+def _fleet_knob():
+    snap = _config.snapshot_overrides(["MXNET_TPU_FLEET"])
+    _config.set("MXNET_TPU_FLEET", True)
+    yield
+    _config.restore_overrides(snap)
+
+
+def _scripted_pair(n=2, step_s=0.005, **kw):
+    from mxnet_tpu.fleet import ScriptedDecodeServer, ServeWire
+    srvs, wires = [], []
+    for r in range(n):
+        s = ScriptedDecodeServer(step_s=step_s,
+                                 name="t%d_%s" % (r, _uniq()), **kw)
+        wires.append(ServeWire(s, rank=r))
+        srvs.append(s)
+    return srvs, wires
+
+
+_SEQ = [0]
+
+
+def _uniq():
+    _SEQ[0] += 1
+    return "u%d" % _SEQ[0]
+
+
+def _ref_stream(prompt, n):
+    from mxnet_tpu.fleet import scripted_token
+    seq, out = list(prompt), []
+    for _ in range(n):
+        t = scripted_token(seq)
+        out.append(t)
+        seq.append(t)
+    return out
+
+
+def _teardown(gw, srvs, wires):
+    gw.close(drain=False, timeout=10.0)
+    for w in wires:
+        w.stop()
+    for s in srvs:
+        try:
+            s.close(drain=False, timeout=2.0)
+        except Exception:                                   # noqa: BLE001
+            pass
+
+
+# ---------------------------------------------------------------- wire
+
+def test_wire_streams_and_roundtrips_stats():
+    from mxnet_tpu.fleet import FleetClient
+    srvs, wires = _scripted_pair(n=1)
+    try:
+        cli = FleetClient(wires[0].address)
+        assert cli.ping()
+        toks = cli.generate([1, 2, 3], max_new_tokens=8,
+                            result_timeout=30.0)
+        assert toks == _ref_stream([1, 2, 3], 8)
+        snap = cli.stats()
+        assert snap["tokens"] >= 8
+        assert snap["kv"]["max_slots"] == 4
+        text = cli.metrics_text()
+        assert 'replica="0"' in text
+    finally:
+        for w in wires:
+            w.stop()
+        for s in srvs:
+            s.close(drain=False, timeout=2.0)
+
+
+def test_wire_rehydrates_serve_exceptions():
+    from mxnet_tpu.fleet import FleetClient, ScriptedDecodeServer, ServeWire
+    s = ScriptedDecodeServer(slots=1, step_s=0.05, queue_bound=1,
+                             name="shed_" + _uniq())
+    w = ServeWire(s, rank=0)
+    try:
+        cli = FleetClient(w.address)
+        h1 = cli.submit_generate([1], max_new_tokens=50)
+        time.sleep(0.1)             # resident; slot + queue bound next
+        cli.submit_generate([2], max_new_tokens=50)     # fills the queue
+        with pytest.raises(QueueFull):
+            cli.generate([3], max_new_tokens=4, result_timeout=10.0)
+        h1.cancel()
+    finally:
+        w.stop()
+        s.close(drain=False, timeout=2.0)
+
+
+# ------------------------------------------------------------- gateway
+
+def test_gateway_requires_opt_in_knob():
+    from mxnet_tpu.fleet import Gateway
+    _config.set("MXNET_TPU_FLEET", False)
+    with pytest.raises(MXNetError):
+        Gateway(addresses=[("127.0.0.1", 1)], port=None)
+
+
+def test_gateway_streams_through_client_wire():
+    from mxnet_tpu.fleet import FleetClient, Gateway
+    srvs, wires = _scripted_pair(n=2)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="gwt_" + _uniq(), stats_period=0.1)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 2
+        cli = FleetClient(("127.0.0.1", gw.port))
+        toks = cli.generate([4, 5], max_new_tokens=10,
+                            result_timeout=30.0)
+        assert toks == _ref_stream([4, 5], 10)
+        snap = cli.stats()          # gateway stats through the same wire
+        assert snap["live"] == 2 and snap["tokens"] >= 10
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_routing_spreads_load_least_loaded():
+    from mxnet_tpu.fleet import Gateway
+    srvs, wires = _scripted_pair(n=2, slots=2, step_s=0.01)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="lb_" + _uniq(), stats_period=0.05)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 2
+        handles = [gw.submit_generate([i + 1], max_new_tokens=20)
+                   for i in range(4)]
+        for h in handles:
+            assert len(h.result(timeout=60.0)) == 20
+        # with 4 concurrent 2-slot replica loads, least-loaded MUST
+        # have spread: both replicas decoded something
+        per = [s.stats()["tokens"] for s in srvs]
+        assert all(t > 0 for t in per), per
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_sticky_one_replica_per_stream():
+    from mxnet_tpu.fleet import Gateway
+    srvs, wires = _scripted_pair(n=2)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="stick_" + _uniq(), stats_period=0.05)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 2
+        h = gw.submit_generate([7], max_new_tokens=30)
+        assert len(h.result(timeout=60.0)) == 30
+        # no fail-over happened, so exactly ONE replica carried the
+        # whole stream (stickiness is by construction; this pins it)
+        per = [s.stats()["tokens"] for s in srvs]
+        assert sorted(per) == [0, 30], per
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_gateway_sheds_at_admission_bound():
+    from mxnet_tpu.fleet import Gateway
+    srvs, wires = _scripted_pair(n=1, step_s=0.05)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="bound_" + _uniq(), queue_bound=1,
+                 stats_period=0.1)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 1
+        h = gw.submit_generate([1], max_new_tokens=40)
+        with pytest.raises(QueueFull):
+            gw.submit_generate([2], max_new_tokens=4)
+        h.cancel()
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_ttft_deadline_propagates():
+    from mxnet_tpu.fleet import Gateway
+    # one slot, long resident sequence: the queued request's TTFT
+    # deadline expires inside the REPLICA queue and comes back as
+    # DeadlineExceeded through the wire
+    srvs, wires = _scripted_pair(n=1, slots=1, step_s=0.05)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="dl_" + _uniq(), stats_period=0.1)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 1
+        h1 = gw.submit_generate([1], max_new_tokens=60)
+        time.sleep(0.1)
+        h2 = gw.submit_generate([2], max_new_tokens=4, timeout=0.2)
+        with pytest.raises(DeadlineExceeded):
+            h2.result(timeout=30.0)
+        h1.cancel()
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_close_rejects_new_submits():
+    from mxnet_tpu.fleet import Gateway
+    srvs, wires = _scripted_pair(n=1)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="cl_" + _uniq(), stats_period=0.1)
+    gw.wait_ready(timeout=10.0)
+    gw.close(drain=True, timeout=10.0)
+    with pytest.raises(ServerClosed):
+        gw.submit_generate([1], max_new_tokens=4)
+    for w in wires:
+        w.stop()
+    for s in srvs:
+        s.close(drain=False, timeout=2.0)
+
+
+# ------------------------------------------------------------ fail-over
+
+def test_failover_midstream_exact_continuation():
+    from mxnet_tpu.fleet import Gateway
+    srvs, wires = _scripted_pair(n=2, step_s=0.01)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="fo_" + _uniq(), stats_period=0.05)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 2
+        witness = gw.submit_generate([9], max_new_tokens=40)
+        time.sleep(0.08)            # a few tokens in
+        st = gw.stats()
+        victim = next(r["rank"] for r in st["replicas"]
+                      if r["assigned"] > 0)
+        survivor = 1 - victim
+        # a co-resident sequence on the SURVIVOR must ride through the
+        # victim's death untouched
+        bystander = gw.submit_generate([3, 3], max_new_tokens=40)
+        time.sleep(0.05)
+        wires[victim].stop()
+        srvs[victim].close(drain=False, timeout=2.0)
+        out = witness.result(timeout=60.0)
+        assert out == _ref_stream([9], 40)      # exact, no dup, no gap
+        assert bystander.result(timeout=60.0) == _ref_stream([3, 3], 40)
+        st = gw.stats()
+        assert st["failover"] >= 1
+        assert st["dup_dropped"] == 0
+        # every token the survivor decoded for the witness re-prefilled
+        # from prompt + delivered prefix — delivered exactly once
+        assert st["replicas"][survivor]["state"] == "live"
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_all_replicas_dead_fails_legibly():
+    from mxnet_tpu.fleet import Gateway
+    srvs, wires = _scripted_pair(n=1, step_s=0.01)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="dead_" + _uniq(), stats_period=0.05)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 1
+        h = gw.submit_generate([5], max_new_tokens=60)
+        time.sleep(0.05)
+        wires[0].stop()
+        srvs[0].close(drain=False, timeout=2.0)
+        with pytest.raises(ServeError):
+            h.result(timeout=120.0)
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_gateway_route_fault_kills_one_request():
+    from mxnet_tpu.fleet import Gateway
+    srvs, wires = _scripted_pair(n=1)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="fr_" + _uniq(), stats_period=0.1)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 1
+        faults.install("gateway.route@1:raise")
+        try:
+            h1 = gw.submit_generate([1], max_new_tokens=4)
+            with pytest.raises(ServeError):
+                h1.result(timeout=30.0)
+            # the site fired once; the next request routes normally
+            h2 = gw.submit_generate([2], max_new_tokens=4)
+            assert len(h2.result(timeout=30.0)) == 4
+        finally:
+            faults.clear()
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+# ------------------------------------------------------------- metrics
+
+def test_metrics_federation_parses_with_replica_labels():
+    from mxnet_tpu.fleet import Gateway
+    from mxnet_tpu.obs.prometheus import parse_prometheus
+    srvs, wires = _scripted_pair(n=2)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="met_" + _uniq(), stats_period=0.05)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 2
+        gw.submit_generate([1], max_new_tokens=4).result(timeout=30.0)
+        text = gw.metrics_text()
+        samples = parse_prometheus(text)    # strict: raises on bad text
+        assert samples, "federated exposition empty"
+        replicas = {dict(lbls).get("replica")
+                    for (_name, lbls) in samples}
+        assert "0" in replicas and "1" in replicas
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_merge_prometheus_dedupes_metadata():
+    from mxnet_tpu.fleet import merge_prometheus
+    a = ("# HELP m a counter\n# TYPE m counter\n"
+         'm{replica="0"} 1\n')
+    b = ("# HELP m a counter\n# TYPE m counter\n"
+         'm{replica="1"} 2\n')
+    merged = merge_prometheus([a, b])
+    assert merged.count("# HELP m") == 1
+    assert merged.count("# TYPE m") == 1
+    assert 'm{replica="0"} 1' in merged and 'm{replica="1"} 2' in merged
+
+
+# ------------------------------------------------------------ zero cost
+
+def test_zero_cost_import_gate():
+    """A plain import must not load the fleet (lazy PEP 562 hook)."""
+    code = ("import sys; import mxnet_tpu; "
+            "assert 'mxnet_tpu.fleet' not in sys.modules, 'fleet loaded'; "
+            "import mxnet_tpu.serve; "
+            "assert 'mxnet_tpu.fleet' not in sys.modules, 'serve pulls fleet'; "
+            "print('OK')")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240, env=_child_env())
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
+
+
+def _child_env():
+    import os
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    return env
+
+
+def test_client_accepts_host_port_string():
+    """A 'host:port' string must parse, not be indexed char-by-char
+    into the silently-wrong address ('1', 2)."""
+    from mxnet_tpu.fleet import FleetClient
+    assert FleetClient("127.0.0.1:4242").address == ("127.0.0.1", 4242)
+    assert FleetClient(("10.0.0.1", 7)).address == ("10.0.0.1", 7)
+    with pytest.raises(ValueError):
+        FleetClient("localhost")            # no port
+    with pytest.raises(ValueError):
+        FleetClient("host:notaport")

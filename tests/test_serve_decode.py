@@ -256,6 +256,130 @@ def test_close_drains_waiting_requests(module):
         assert len(h.result(timeout=10)) == 3
 
 
+def test_submit_mid_drain_rejected_promptly(module):
+    """A submit issued WHILE close(drain=True) is still draining must
+    raise ServerClosed immediately — not enqueue behind a scheduler
+    that is about to exit (ISSUE 20 satellite)."""
+    srv = _server(module, max_sequences=1, queue_bound=8, name="middrain")
+    inflight = srv.submit_generate([1, 2], max_new_tokens=10)
+    while not inflight.tokens_so_far():
+        time.sleep(0.01)
+    closer = threading.Thread(target=lambda: srv.close(drain=True))
+    closer.start()
+    deadline = time.time() + 10
+    while not srv._closed and time.time() < deadline:
+        time.sleep(0.001)
+    assert srv._closed
+    t0 = time.time()
+    with pytest.raises(ServerClosed):
+        srv.submit_generate([9], max_new_tokens=2)
+    assert time.time() - t0 < 1.0         # rejected, not queued-then-failed
+    # the drain promise still stands for work admitted before the close
+    assert len(inflight.result(timeout=120)) == 10
+    closer.join(timeout=120)
+    assert not closer.is_alive()
+
+
+def test_second_close_cannot_revoke_drain_promise(module):
+    """close() is idempotent the way InferenceServer.close() documents:
+    a second close(drain=False) during a first close(drain=True) only
+    joins — it must not cancel sequences the first close promised to
+    finish."""
+    srv = _server(module, max_sequences=1, queue_bound=8, name="reclose")
+    slow = srv.submit_generate([3, 5], max_new_tokens=10)
+    queued = srv.submit_generate([4], max_new_tokens=3)
+    while not slow.tokens_so_far():
+        time.sleep(0.01)
+    closer = threading.Thread(target=lambda: srv.close(drain=True))
+    closer.start()
+    while not srv._closed:
+        time.sleep(0.001)
+    srv.close(drain=False, timeout=120)   # must behave as drain=True
+    assert len(slow.result(timeout=120)) == 10
+    assert len(queued.result(timeout=120)) == 3
+    closer.join(timeout=120)
+
+
+# --------------------------------------------------------- tp-sharded KV
+
+HEADS_TP = 4
+
+
+@pytest.fixture(scope="module")
+def module4():
+    """4-head variant: the tp=4 island needs a head axis it can split
+    (2 heads over tp=4 would leave idle shards)."""
+    from mxnet_tpu.models import transformer
+    net = transformer.get_symbol(vocab_size=VOCAB, num_layers=LAYERS,
+                                 d_model=DMODEL, n_heads=HEADS_TP,
+                                 seq_len=SEQ)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, SEQ))],
+             label_shapes=[("softmax_label", (1, SEQ))])
+    mx.random.seed(11)
+    mod.init_params(mx.init.Uniform(0.05))
+    return mod
+
+
+def test_tp_sharded_kv_decode_parity(module4):
+    """GenerativeServer with the KV cache head axis sharded tp=4 over
+    the 8-device virtual mesh (``island_specs("serve")``): greedy
+    tokens identical to the unsharded server, joins/evictions work, and
+    steady-state decode stays at ZERO recompiles (ISSUE 20 satellite)."""
+    from mxnet_tpu.parallel import SpecLayout
+    lo = SpecLayout(tp=4).sized(8)
+    mesh = lo.mesh()
+    ref_srv = GenerativeServer(module4, n_heads=HEADS_TP, max_sequences=4,
+                               page=4, int8=False, name="tpref")
+    try:
+        ref = {}
+        for p in ([3, 11, 7], [5, 2]):
+            ref[tuple(p)] = ref_srv.submit_generate(
+                p, max_new_tokens=8).result(timeout=120)
+    finally:
+        ref_srv.close()
+    srv = GenerativeServer(module4, n_heads=HEADS_TP, max_sequences=4,
+                           page=4, int8=False, name="tpshard",
+                           mesh=mesh, layout=lo)
+    try:
+        first = srv.submit_generate([3, 11, 7], max_new_tokens=8)
+        while not first.tokens_so_far():
+            time.sleep(0.01)
+        joiner = srv.submit_generate([5, 2], max_new_tokens=8)   # mid-flight
+        assert first.result(timeout=240) == ref[(3, 11, 7)]
+        assert joiner.result(timeout=240) == ref[(5, 2)]
+        warm = profiler.get_counter("tpshard_compile")
+        wave = [srv.submit_generate([i + 1, i + 2], max_new_tokens=6)
+                for i in range(4)]
+        for h in wave:
+            assert len(h.result(timeout=240)) == 6
+        # every bucket warm: the second wave moved the counter by ZERO
+        assert profiler.get_counter("tpshard_compile") == warm
+        st = srv.stats()
+        assert st["kv"]["slots_in_use"] == 0       # evictions freed pages
+    finally:
+        srv.close()
+
+
+def test_tp_sharded_int8_parity(module4):
+    """int8 KV under the tp=4 sharding: greedy tokens match the sharded
+    f32 server (the quantized page layout shards the same head axis)."""
+    from mxnet_tpu.parallel import SpecLayout
+    lo = SpecLayout(tp=4).sized(8)
+    mesh = lo.mesh()
+    out = {}
+    for int8 in (False, True):
+        srv = GenerativeServer(module4, n_heads=HEADS_TP, max_sequences=4,
+                               page=4, int8=int8, mesh=mesh, layout=lo,
+                               name="tpq%d" % int8)
+        try:
+            out[int8] = srv.submit_generate(
+                [3, 11, 7], max_new_tokens=8).result(timeout=240)
+        finally:
+            srv.close()
+    assert out[False] == out[True]
+
+
 def test_capacity_truncation(module):
     """A sequence hitting max_seq finishes (truncated) instead of
     wedging the batch."""

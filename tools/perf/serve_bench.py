@@ -343,6 +343,242 @@ def _bench_decode(quick=False, reps=1):
     return out
 
 
+# ===================================================================
+# Fleet: multi-replica gateway scaling + kill-one-under-load
+# ===================================================================
+
+_FLEET_STEP_MS = 20.0
+_FLEET_SLOTS = 8
+_FLEET_NEW_TOKENS = 32
+
+
+def _fleet_closed_loop(gw, clients, n_req, new_tokens):
+    """``clients`` closed-loop generators against one Gateway; returns
+    (aggregate tok/s, gateway stats snapshot)."""
+    prompts = _decode_prompts(64)
+    tokens_out = [0] * clients
+    errors = []
+
+    def client(cid):
+        try:
+            per = max(n_req // clients, 1)
+            for i in range(per):
+                h = gw.submit_generate(
+                    prompts[(cid + i * clients) % len(prompts)],
+                    max_new_tokens=new_tokens)
+                tokens_out[cid] += len(h.result(timeout=600))
+        except Exception as exc:                           # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return sum(tokens_out) / dt, gw.stats()
+
+
+def _fleet_scaling(quick=False):
+    """Aggregate tok/s and gateway TTFT for 1/2/3 DEVICE-PACED replicas
+    at matched per-replica deployments (1xS vs 2xS vs 3xS slots).
+
+    Replicas are real subprocesses behind the real wire, but their
+    decode step is the scripted simulator's timed wait — the TPU regime
+    where the device does the work and the host idles between steps.
+    The host-side fleet fabric (gateway scheduler, routing, sockets,
+    per-token frame handling) is measured for real; only device time is
+    simulated. On this device-less bench host a REAL model's decode
+    step is host CPU, so N co-resident replica processes just split one
+    core N ways — that anti-scaling measures the box, not the gateway
+    (recorded honestly in the ``real_model`` section)."""
+    from mxnet_tpu.fleet import Gateway
+    spec = {"kind": "scripted", "slots": _FLEET_SLOTS,
+            "step_ms": _FLEET_STEP_MS, "prefill_ms_per_token": 1.0,
+            "name": "benchrep"}
+    new_tokens = 16 if quick else _FLEET_NEW_TOKENS
+    out = {
+        "mode": "device_paced_scripted_replicas",
+        "pacing": {"step_ms": _FLEET_STEP_MS,
+                   "slots_per_replica": _FLEET_SLOTS,
+                   "new_tokens_per_request": new_tokens,
+                   "device_paced_ceiling_tps_per_replica": round(
+                       _FLEET_SLOTS / (_FLEET_STEP_MS / 1e3), 1)},
+    }
+    base_tps = None
+    for n in ((1, 2) if quick else (1, 2, 3)):
+        gw = Gateway(spec=spec, replicas=n, port=None, stats_period=0.2,
+                     name="bench_fleet%d" % n)
+        try:
+            live = gw.wait_ready(n, timeout=300.0)
+            assert live == n, "only %d/%d replicas live" % (live, n)
+            clients = 2 * _FLEET_SLOTS * n
+            n_req = (2 if quick else 4) * clients
+            tps, st = _fleet_closed_loop(gw, clients, n_req, new_tokens)
+        finally:
+            gw.close(drain=False, timeout=60.0)
+        rec = {"replicas": n, "clients": clients,
+               "aggregate_tps": round(tps, 1),
+               "ttft": st["ttft"], "tpot": st["tpot"],
+               "failover": st["failover"], "shed": st["shed"]}
+        if base_tps is None:
+            base_tps = tps
+        else:
+            rec["speedup_vs_1_replica"] = round(tps / base_tps, 2)
+        out["replicas_%d" % n] = rec
+        print("fleet r=%d  %8.1f tok/s  %s  ttft p50 %s ms p99 %s ms"
+              % (n, tps,
+                 ("%.2fx" % (tps / base_tps)) if n > 1 else "  1x ",
+                 (st["ttft"] or {}).get("p50_ms"),
+                 (st["ttft"] or {}).get("p99_ms")))
+    ratio = out["replicas_2"]["speedup_vs_1_replica"]
+    assert ratio >= 1.6, (
+        "2-replica aggregate only %.2fx of 1 replica on matched "
+        "per-replica deployments (want >= 1.6x)" % ratio)
+    return out
+
+
+def _fleet_kill_under_load():
+    """REAL model replicas: kill one mid-stream under load; record
+    recovery time and assert zero token duplication (every stream
+    bit-equal to a single-server reference)."""
+    import os as _os
+    import signal as _signal
+    import tempfile as _tempfile
+    from mxnet_tpu.fleet import Gateway
+    from mxnet_tpu.fleet.replica import build_from_spec
+    geo = dict(_DECODE_GEO, seq_len=32)
+    spec = {"kind": "transformer", "geo": geo, "seed": 11, "slots": 4,
+            "page": 8, "name": "benchkill"}
+    _os.environ["MXNET_TPU_COMPILE_CACHE"] = _tempfile.mkdtemp(
+        prefix="fleet_bench_aot_")
+    ref_srv = build_from_spec(dict(spec, name="benchkillref"))
+    prompts = [[3, 1, 4], [1, 5, 9], [2, 6], [5, 3, 5],
+               [8, 9, 7], [3, 2], [7, 7, 1], [9, 4]]
+    new_tokens = 12
+    try:
+        ref = {tuple(p): ref_srv.submit_generate(
+                   p, max_new_tokens=new_tokens).result(timeout=600)
+               for p in prompts}
+    finally:
+        ref_srv.close()
+    gw = Gateway(spec=spec, replicas=2, port=None, stats_period=0.2,
+                 name="bench_kill")
+    try:
+        assert gw.wait_ready(2, timeout=600.0) == 2
+        handles = [(p, gw.submit_generate(p, max_new_tokens=new_tokens))
+                   for p in prompts]
+        # kill a replica once streams are moving
+        deadline = time.perf_counter() + 60
+        victim_pid = None
+        while time.perf_counter() < deadline and victim_pid is None:
+            st = gw.stats()
+            for r in st["replicas"]:
+                if r["assigned"] > 0 and r["stats"].get("pid"):
+                    victim_pid = r["stats"]["pid"]
+                    break
+            time.sleep(0.02)
+        assert victim_pid, "no replica ever took load"
+        t_kill = time.perf_counter()
+        _os.kill(victim_pid, _signal.SIGKILL)
+        dup_tokens = 0
+        for p, h in handles:
+            got = h.result(timeout=600)
+            assert got == ref[tuple(p)], \
+                "stream for %s diverged after the kill" % (p,)
+        recovery_s = time.perf_counter() - t_kill
+        st = gw.stats()
+        assert st["dup_dropped"] == 0, st["dup_dropped"]
+        heal_deadline = time.perf_counter() + 300
+        while time.perf_counter() < heal_deadline \
+                and gw.stats()["live"] < 2:
+            time.sleep(0.2)
+        respawn_s = time.perf_counter() - t_kill
+        rec = {
+            "replicas": 2, "in_flight_at_kill": len(prompts),
+            "all_streams_complete_after_kill_s": round(recovery_s, 3),
+            "respawned_to_full_strength_s": round(respawn_s, 3),
+            "failover": st["failover"],
+            "duplicated_tokens": dup_tokens + st["dup_dropped"],
+            "streams_bit_equal_to_reference": True,
+        }
+        print("fleet kill drill: %d streams recovered in %.2fs, world "
+              "healed in %.2fs, 0 duplicated tokens"
+              % (len(prompts), recovery_s, respawn_s))
+        return rec
+    finally:
+        gw.close(drain=False, timeout=60.0)
+
+
+def _fleet_real_model_record():
+    """The honest number: real-model replicas on THIS host. Decode here
+    is host-CPU-bound (no device), so replica processes contend for the
+    same core and aggregate throughput does NOT scale — recorded as-is
+    with the reason, next to the device-paced table that models the TPU
+    regime."""
+    from mxnet_tpu.fleet import Gateway
+    from mxnet_tpu.fleet.replica import build_from_spec
+    geo = dict(_DECODE_GEO, seq_len=32)
+    spec = {"kind": "transformer", "geo": geo, "seed": 11, "slots": 4,
+            "page": 8, "name": "benchreal"}
+    new_tokens, n_req = 12, 24
+    solo = build_from_spec(dict(spec, name="benchrealsolo"))
+    prompts = _decode_prompts(16)
+    try:
+        done = 0
+        t0 = time.perf_counter()
+        hs = [solo.submit_generate(prompts[i % len(prompts)],
+                                   max_new_tokens=new_tokens)
+              for i in range(n_req)]
+        for h in hs:
+            done += len(h.result(timeout=600))
+        solo_tps = done / (time.perf_counter() - t0)
+    finally:
+        solo.close()
+    gw = Gateway(spec=spec, replicas=2, port=None, stats_period=0.2,
+                 name="bench_real")
+    try:
+        assert gw.wait_ready(2, timeout=600.0) == 2
+        fleet_tps, _ = _fleet_closed_loop(gw, clients=8, n_req=n_req,
+                                          new_tokens=new_tokens)
+    finally:
+        gw.close(drain=False, timeout=60.0)
+    rec = {
+        "single_server_tps": round(solo_tps, 1),
+        "fleet_2_replica_tps": round(fleet_tps, 1),
+        "ratio": round(fleet_tps / solo_tps, 2),
+        "note": ("decode on this bench host is CPU-bound (no "
+                 "accelerator), so the ratio measures host scheduling "
+                 "across 2 replica processes sharing the same cores, "
+                 "not device scaling; the device_paced table above "
+                 "models the TPU regime where the device decodes and "
+                 "the host-side fleet fabric is the measured part"),
+    }
+    print("fleet real-model (host-CPU-bound): solo %.1f tok/s vs "
+          "2-replica %.1f tok/s (%.2fx) — see note"
+          % (solo_tps, fleet_tps, rec["ratio"]))
+    return rec
+
+
+def _bench_fleet(quick=False):
+    """The ISSUE 20 acceptance table: aggregate tok/s + TTFT p50/95/99
+    for 1/2/3 replicas at matched per-replica deployments, the
+    kill-one-replica-under-load record (recovery time, zero token
+    duplication), and the honest real-model record for this host."""
+    from mxnet_tpu import config as _config
+    _config.set("MXNET_TPU_FLEET", True)
+    _config.set("MXNET_TPU_ELASTIC_BACKOFF", 0.2)
+    out = _fleet_scaling(quick=quick)
+    out["kill_under_load"] = _fleet_kill_under_load()
+    if not quick:
+        out["real_model"] = _fleet_real_model_record()
+    return out
+
+
 def run(quick=False, reps=1):
     n_req = 400 if quick else 4000
     clients = 16 if quick else 32
@@ -387,8 +623,15 @@ def main():
     ap.add_argument("--decode-json", default=None,
                     help="write the decode section to PATH "
                          "(BENCH_decode.json)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run only the multi-replica fleet section")
+    ap.add_argument("--fleet-json", default=None,
+                    help="write the fleet section to PATH "
+                         "(BENCH_fleet.json)")
     args = ap.parse_args()
-    if args.decode_only:
+    if args.fleet:
+        results = {"fleet": _bench_fleet(quick=args.quick)}
+    elif args.decode_only:
         results = {"decode": _bench_decode(quick=args.quick,
                                            reps=args.reps)}
     else:
@@ -405,6 +648,12 @@ def main():
         with open(args.decode_json, "w") as f:
             json.dump(payload, f, indent=2)
         print("wrote", args.decode_json)
+    if args.fleet_json:
+        payload = dict(results["fleet"])
+        payload["bench"] = "fleet"
+        with open(args.fleet_json, "w") as f:
+            json.dump(payload, f, indent=2)
+        print("wrote", args.fleet_json)
     return results
 
 
