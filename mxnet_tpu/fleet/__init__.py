@@ -31,10 +31,11 @@ from .client import FleetClient
 from .gateway import Gateway, merge_prometheus
 from .replica import (ReplicaFront, ScriptedDecodeServer, build_from_spec,
                       run_replica, scripted_token)
-from .wire import ServeWire, ping, request_value, stream_generate
+from .wire import ServeWire, ping, probe, request_value, stream_generate
 
 __all__ = [
     "Gateway", "FleetClient", "ServeWire", "ScriptedDecodeServer",
     "ReplicaFront", "build_from_spec", "run_replica", "scripted_token",
-    "merge_prometheus", "ping", "request_value", "stream_generate",
+    "merge_prometheus", "ping", "probe", "request_value",
+    "stream_generate",
 ]

@@ -35,6 +35,13 @@ One gateway process fronts N replica processes (each a
   drop (``fleet_dup_dropped``). Survivor-resident sequences are never
   touched — the victim's sequences arrive as fresh admissions at step
   granularity, the same continuous-batching join any new request makes.
+  A replica's clean ``END`` distinguishes ``done`` (contract met, EOS,
+  or KV-capacity truncation — a complete result, finished as a bare
+  server would finish it) from ``released`` (a draining shutdown let
+  go of an unfinished sequence — the remainder re-dispatches).
+  Continuations are bit-equal for greedy decode; seeded sampling
+  re-derives its seed from the fail-over point (deterministic, but a
+  divergent sample path — see ``submit_generate``).
 
 * **Federated obs** — ``/metrics`` merges the gateway's own registry
   with every live replica's ``replica=<r>``-labeled exposition
@@ -426,7 +433,19 @@ class Gateway(object):
                         on_token=None) -> GenerateHandle:
         """Same contract as ``GenerativeServer.submit_generate`` — the
         fleet is a drop-in for a single server. ``timeout`` is the TTFT
-        deadline and propagates to the serving replica."""
+        deadline and propagates to the serving replica (first-token
+        admission only: once a token has been delivered, fail-over
+        re-dispatch is not deadline-bounded).
+
+        Determinism across fail-over: greedy decode
+        (``temperature=0``) is bit-equal to an uninterrupted stream —
+        the survivor re-prefills ``prompt + delivered-prefix`` and
+        argmax depends only on the sequence. Seeded sampling
+        (``temperature>0`` with ``seed``) is reproducible run-to-run
+        but NOT bit-equal across a fail-over: the survivor's RNG
+        cannot resume the dead replica's draw stream, so the
+        continuation uses a seed derived from (seed, fail-over point)
+        — deterministic, but a divergent sample path."""
         if hasattr(prompt, "asnumpy"):
             prompt = prompt.asnumpy()
         if hasattr(prompt, "tolist"):
@@ -501,8 +520,25 @@ class Gateway(object):
         if addr is None:
             return ("died", ConnectionResetError("replica restarting"))
         remaining = None
-        if req.deadline is not None:
+        if req.deadline is not None and not req.delivered:
+            # the TTFT deadline constrains only the FIRST token (the
+            # _drive guard): a fail-over re-dispatch after delivery
+            # must not carry the expired deadline into the survivor's
+            # admission, which would fail a request whose TTFT was
+            # already satisfied
             remaining = max(0.05, req.deadline - monotonic())
+        seed = req.seed
+        if seed is not None and req.delivered:
+            # a survivor's RNG restarts at draw 0, so a seeded
+            # temperature>0 continuation cannot replay the dead
+            # replica's draw stream; deriving the continuation seed
+            # from the fail-over point keeps the re-dispatched stream
+            # deterministic (same prefix -> same continuation) instead
+            # of silently reusing draws 0..k at the wrong token
+            # positions. Greedy decode stays bit-equal either way.
+            seed = (int(seed)
+                    ^ (0x9E3779B97F4A7C15 * len(req.delivered))) \
+                & ((1 << 63) - 1)
         payload = {
             "prompt": req.prompt,
             "prefix": list(req.delivered),
@@ -510,7 +546,7 @@ class Gateway(object):
             "max_new_tokens": req.max_new_tokens - len(req.delivered),
             "eos_id": req.eos_id,
             "temperature": req.temperature,
-            "seed": req.seed,
+            "seed": seed,
             "timeout": remaining,
         }
 
@@ -532,7 +568,18 @@ class Gateway(object):
                 _profiler.incr_counter(self.name + "_dup_dropped")
 
         try:
-            _wire.stream_generate(addr, payload, on_frame)
+            end = _wire.stream_generate(addr, payload, on_frame)
+            done = (len(req.delivered) >= req.max_new_tokens
+                    or (req.eos_id is not None and req.delivered
+                        and req.delivered[-1] == req.eos_id))
+            if not done and isinstance(end, dict) \
+                    and end.get("reason", "released") == "released":
+                # the replica let go of an UNfinished sequence (a
+                # draining shutdown cancels at a step boundary): the
+                # remainder re-dispatches like a death. A short "done"
+                # END is a COMPLETE result (KV-capacity truncation) —
+                # a bare server finishes such a request, so we do too.
+                return ("released", None)
             return None
         except (QueueFull, ServerClosed) as exc:
             return ("shed", exc)
@@ -546,16 +593,9 @@ class Gateway(object):
 
     def _note_stream_break(self, rep: _Replica, gen: int, addr) -> None:
         """A broken stream is only a SUSPICION; the PING probe
-        adjudicates (refused = dead, timeout = ambiguous — exactly the
-        ProbeRing distinction)."""
-        try:
-            _wire.request_value(addr, "PING", timeout=1.0)
-            confirmed = False
-        except ConnectionRefusedError:
-            confirmed = True
-        except OSError:
-            confirmed = False
-        if not confirmed:
+        adjudicates (refused = dead, PONG = alive, timeout/garbage =
+        ambiguous — exactly the ProbeRing distinction)."""
+        if _wire.probe(addr, timeout=1.0) != "dead":
             return
         with self._cond:
             if rep.generation == gen and rep.state == "live":
@@ -632,25 +672,13 @@ class Gateway(object):
                 with self._lock:
                     rep.assigned -= 1
             if verdict is None:
-                if len(req.delivered) >= req.max_new_tokens or (
-                        req.eos_id is not None and req.delivered
-                        and req.delivered[-1] == req.eos_id):
-                    self._finish(req, None)
-                    return
-                # a clean END short of the contract: the replica let go
-                # of the sequence without erroring (graceful shutdown
-                # cancels at a step boundary) — re-dispatch the
-                # remainder exactly like a death
-                _profiler.incr_counter(self.name + "_failover")
-                attempts += 1
-                if attempts > self._max_attempts:
-                    self._finish(req, ServeError(
-                        "fail-over budget exhausted after %d attempts "
-                        "(replicas keep ending the stream early)"
-                        % attempts))
-                    return
-                excluded = set()
-                continue
+                # a "done" END: the replica finished the sequence on
+                # its own terms — contract met, EOS, or KV-capacity
+                # truncation. All are complete results (a bare server
+                # finishes a truncated request short too; re-dispatch
+                # would re-prefill past max_seq and fail it).
+                self._finish(req, None)
+                return
             kind, exc = verdict
             if kind == "fatal":
                 if isinstance(exc, DeadlineExceeded):
@@ -662,12 +690,17 @@ class Gateway(object):
             if attempts > self._max_attempts:
                 self._finish(req, ServeError(
                     "fail-over budget exhausted after %d attempts "
-                    "(last: %s)" % (attempts, exc)))
+                    "(last: %s)" % (attempts,
+                                    exc if exc is not None
+                                    else "replica released the stream")))
                 return
             if kind == "shed":
                 _profiler.incr_counter(self.name + "_shed")
                 excluded.add(rep.rank)
-            else:                   # died: fail-over to a survivor
+            else:
+                # died (transport death) or released (the replica
+                # cancelled an unfinished sequence while draining):
+                # fail-over the remainder to a survivor
                 _profiler.incr_counter(self.name + "_failover")
                 excluded = set()    # dead rank is excluded via state
 

@@ -24,7 +24,16 @@ peer death)::
                                 NOT re-sent — ``idx`` starts at the
                                 request's ``start``), the at-most-once
                                 dedup key
-    END <b64 json>              the stream finished ({"n": count})
+    END <b64 json>              the stream finished ({"n": count,
+                                "reason": "done"|"released"}).
+                                ``done``: the server finished the
+                                sequence on its own terms (contract
+                                met, EOS, or KV-capacity truncation —
+                                a complete result); ``released``: the
+                                server let go of an UNfinished
+                                sequence (a draining shutdown cancels
+                                at a step boundary — the gateway
+                                re-dispatches the remainder)
     ERR <b64 json>              {"kind": shed|deadline|closed|error,
                                  "msg": ...} — ``kind`` tells the
                                 gateway whether to retry elsewhere
@@ -49,7 +58,7 @@ from ..serve.server import (DeadlineExceeded, GenerateHandle, QueueFull,
                             ServeError, ServerClosed)
 
 __all__ = ["ServeWire", "stream_generate", "request_value", "ping",
-           "dumps_b64", "loads_b64"]
+           "probe", "dumps_b64", "loads_b64"]
 
 _CONNECT_TIMEOUT = 5.0
 # a healthy stream's inter-frame gap is bounded by one decode step; a
@@ -238,8 +247,15 @@ class ServeWire(object):
                     # frame is on the wire, so the drill's token count
                     # is exact
                     _faults.fire(self.fault_site, default_kind="sigkill")
-            conn.sendall(("END %s\n" % dumps_b64({"n": n}))
-                         .encode("ascii"))
+            # a cancelled handle ended because the server RELEASED the
+            # sequence (draining shutdown), not because it finished —
+            # the distinction tells the gateway whether a short stream
+            # is a complete result (KV-capacity truncation, EOS) or a
+            # remainder to re-dispatch
+            reason = ("released" if getattr(handle, "_cancelled", False)
+                      else "done")
+            conn.sendall(("END %s\n" % dumps_b64(
+                {"n": n, "reason": reason})).encode("ascii"))
         except OSError:
             # the caller vanished (gateway fail-over already re-routed,
             # or a client gave up): stop streaming, free the sequence
@@ -262,8 +278,8 @@ def _connect(address: Tuple[str, int],
 
 def ping(address: Tuple[str, int], timeout: float = 1.0) -> bool:
     """One PING round-trip. False on ANY failure — callers that need
-    the dead/unreachable distinction (the probe rule) catch
-    ConnectionRefusedError themselves via :func:`request_value`."""
+    the dead/unreachable distinction (the probe rule) use
+    :func:`probe` instead."""
     try:
         with _connect(address, timeout=timeout) as conn:
             conn.settimeout(timeout)
@@ -271,6 +287,24 @@ def ping(address: Tuple[str, int], timeout: float = 1.0) -> bool:
             return conn.makefile("r").readline().strip() == "PONG"
     except OSError:
         return False
+
+
+def probe(address: Tuple[str, int], timeout: float = 1.0) -> str:
+    """Liveness adjudication: one PING round-trip, returning
+    ``"alive"`` (a PONG came back), ``"dead"`` (connection refused —
+    the probe-confirmed death signal), or ``"ambiguous"`` (timeout,
+    EOF, malformed reply — never grounds for a kill verdict). The
+    ProbeRing refused-vs-timeout rule on the fleet wire."""
+    try:
+        with _connect(address, timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            conn.sendall(b"PING\n")
+            line = conn.makefile("r", encoding="utf-8").readline()
+    except ConnectionRefusedError:
+        return "dead"
+    except OSError:
+        return "ambiguous"
+    return "alive" if line.strip() == "PONG" else "ambiguous"
 
 
 def request_value(address: Tuple[str, int], op: str,

@@ -113,15 +113,103 @@ def test_wire_rehydrates_serve_exceptions():
     w = ServeWire(s, rank=0)
     try:
         cli = FleetClient(w.address)
+        # the client submit is async (a daemon thread drives the wire),
+        # so sequence the fill deterministically: [1] resident FIRST,
+        # then [2] into the one queue slot — submitting both at once
+        # races [2] against [1]'s admission and the shed lands on the
+        # wrong request
+
+        def _wait(pred, what):
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if pred(s.stats()):
+                    return
+                time.sleep(0.01)
+            pytest.fail("server never reached " + what)
+
         h1 = cli.submit_generate([1], max_new_tokens=50)
-        time.sleep(0.1)             # resident; slot + queue bound next
-        cli.submit_generate([2], max_new_tokens=50)     # fills the queue
+        _wait(lambda st: st["active_sequences"] >= 1, "slot-full")
+        h2 = cli.submit_generate([2], max_new_tokens=50)
+        _wait(lambda st: st["waiting"] >= 1, "queue-full")
         with pytest.raises(QueueFull):
             cli.generate([3], max_new_tokens=4, result_timeout=10.0)
         h1.cancel()
+        h2.cancel()
     finally:
         w.stop()
         s.close(drain=False, timeout=2.0)
+
+
+def test_wire_end_reason_distinguishes_done_from_released():
+    from mxnet_tpu.fleet import wire as fwire
+    srvs, wires = _scripted_pair(n=1, step_s=0.005)
+    s, w = srvs[0], wires[0]
+    try:
+        # finished on the server's own terms -> reason "done"
+        got = []
+        end = fwire.stream_generate(
+            w.address,
+            {"prompt": [1], "prefix": [], "start": 0,
+             "max_new_tokens": 4, "eos_id": None, "temperature": 0.0,
+             "seed": None, "timeout": None},
+            lambda i, t: got.append(t))
+        assert end["n"] == 4 and end["reason"] == "done"
+        assert got == _ref_stream([1], 4)
+        # a draining shutdown cancels the sequence -> reason "released"
+        box = {}
+
+        def run():
+            try:
+                box["end"] = fwire.stream_generate(
+                    w.address,
+                    {"prompt": [2], "prefix": [], "start": 0,
+                     "max_new_tokens": 10000, "eos_id": None,
+                     "temperature": 0.0, "seed": None, "timeout": None},
+                    lambda i, t: None)
+            except BaseException as exc:                    # noqa: BLE001
+                box["exc"] = exc
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.1)             # a few tokens in
+        s.close(drain=False, timeout=5.0)
+        t.join(10.0)
+        assert box.get("end", {}).get("reason") == "released"
+    finally:
+        w.stop()
+        s.close(drain=False, timeout=2.0)
+
+
+def test_probe_adjudicates_alive_dead_ambiguous():
+    import socket
+    from mxnet_tpu.fleet import probe
+    from mxnet_tpu.parallel.dist import free_port
+    srvs, wires = _scripted_pair(n=1)
+    try:
+        assert probe(wires[0].address, timeout=2.0) == "alive"
+    finally:
+        wires[0].stop()
+        srvs[0].close(drain=False, timeout=2.0)
+    # connection refused = the probe-confirmed death signal
+    assert probe(("127.0.0.1", free_port()), timeout=1.0) == "dead"
+    # a peer answering garbage is never grounds for a kill verdict
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def answer():
+        conn, _ = srv.accept()
+        conn.recv(64)
+        conn.sendall(b"WAT\n")
+        conn.close()
+
+    t = threading.Thread(target=answer, daemon=True)
+    t.start()
+    try:
+        assert probe(srv.getsockname(), timeout=2.0) == "ambiguous"
+    finally:
+        srv.close()
 
 
 # ------------------------------------------------------------- gateway
@@ -267,6 +355,71 @@ def test_failover_midstream_exact_continuation():
         # every token the survivor decoded for the witness re-prefilled
         # from prompt + delivered prefix — delivered exactly once
         assert st["replicas"][survivor]["state"] == "live"
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_failover_redispatch_drops_ttft_and_derives_seed(monkeypatch):
+    # the TTFT deadline constrains only the FIRST token: a fail-over
+    # re-dispatch after delivery must not carry the (long-expired)
+    # deadline into the survivor's admission, and a seeded request's
+    # continuation seed derives from the fail-over point instead of
+    # replaying the original seed's draws at the wrong positions
+    from mxnet_tpu.fleet import Gateway
+    from mxnet_tpu.fleet import wire as fwire
+    srvs, wires = _scripted_pair(n=1, step_s=0.005)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="rdp_" + _uniq(), stats_period=0.1)
+    payloads = []
+    real = fwire.stream_generate
+
+    def fake(addr, payload, on_frame, **kw):
+        payloads.append(dict(payload))
+        if len(payloads) == 1:
+            for i, t in enumerate(_ref_stream([7], 2)):
+                on_frame(i, t)      # two tokens out, then die
+            raise ConnectionResetError("mid-stream death")
+        return real(addr, payload, on_frame, **kw)
+
+    monkeypatch.setattr(fwire, "stream_generate", fake)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 1
+        h = gw.submit_generate([7], max_new_tokens=8, timeout=5.0,
+                               seed=123)
+        assert h.result(timeout=30.0) == _ref_stream([7], 8)
+        assert len(payloads) == 2
+        assert payloads[0]["timeout"] is not None
+        assert payloads[0]["seed"] == 123
+        assert payloads[1]["start"] == 2
+        assert payloads[1]["prefix"] == _ref_stream([7], 2)
+        assert payloads[1]["timeout"] is None
+        assert payloads[1]["seed"] not in (None, 123)
+    finally:
+        _teardown(gw, srvs, wires)
+
+
+def test_short_done_end_is_a_complete_result(monkeypatch):
+    # a replica's KV-capacity truncation ENDs the stream cleanly SHORT
+    # with reason "done": the gateway must finish the request as a bare
+    # server would — not burn fail-over budget re-prefilling a prompt
+    # that already outgrew max_seq
+    from mxnet_tpu.fleet import Gateway
+    from mxnet_tpu.fleet import wire as fwire
+
+    def fake(addr, payload, on_frame, **kw):
+        for i, t in enumerate(_ref_stream([5], 3)):
+            on_frame(i, t)
+        return {"n": 3, "reason": "done"}
+
+    monkeypatch.setattr(fwire, "stream_generate", fake)
+    srvs, wires = _scripted_pair(n=1)
+    gw = Gateway(addresses=[w.address for w in wires],
+                 name="trunc_" + _uniq(), stats_period=0.1)
+    try:
+        assert gw.wait_ready(timeout=10.0) == 1
+        h = gw.submit_generate([5], max_new_tokens=64)
+        assert h.result(timeout=30.0) == _ref_stream([5], 3)
+        assert gw.stats()["failover"] == 0
     finally:
         _teardown(gw, srvs, wires)
 
